@@ -1,0 +1,146 @@
+"""Native runtime: CDCL SAT core + keccak-256, built from C++ on first import.
+
+This package is the build's native-substrate analog of the reference's
+third-party native wheels (z3-solver C++ lib, eth-hash keccak backend —
+reference requirements.txt:40, mythril/support/support_utils.py:94). The
+shared library is compiled once with the system toolchain and bound via
+ctypes (no pybind11 in this environment).
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "_native.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    proc = subprocess.run(
+        ["make", "-s"], cwd=_HERE, capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed (exit {proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+
+def get_lib() -> ctypes.CDLL:
+    """Load (building if needed) the native library and bind signatures."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or _needs_rebuild():
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.mtpu_keccak256.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        lib.mtpu_keccak256.restype = None
+        lib.mtpu_sat_new.restype = ctypes.c_void_p
+        lib.mtpu_sat_free.argtypes = [ctypes.c_void_p]
+        lib.mtpu_sat_new_var.argtypes = [ctypes.c_void_p]
+        lib.mtpu_sat_new_var.restype = ctypes.c_int32
+        lib.mtpu_sat_add_clause.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mtpu_sat_add_clause.restype = ctypes.c_int32
+        lib.mtpu_sat_solve.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_double,
+            ctypes.c_int64,
+        ]
+        lib.mtpu_sat_solve.restype = ctypes.c_int32
+        lib.mtpu_sat_value.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.mtpu_sat_value.restype = ctypes.c_int32
+        lib.mtpu_sat_stats.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.mtpu_sat_stats.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def _needs_rebuild() -> bool:
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    for src in ("sat.cpp", "keccak.cpp"):
+        if os.path.getmtime(os.path.join(_HERE, src)) > so_mtime:
+            return True
+    return False
+
+
+def keccak256(data: bytes) -> bytes:
+    """EVM keccak-256 of ``data``."""
+    lib = get_lib()
+    out = ctypes.create_string_buffer(32)
+    lib.mtpu_keccak256(data, len(data), out)
+    return out.raw
+
+
+class SatSolver:
+    """Thin OO wrapper over the native CDCL core.
+
+    Literals are DIMACS-style signed ints over 1-based variables.
+    """
+
+    def __init__(self) -> None:
+        self._lib = get_lib()
+        self._h = self._lib.mtpu_sat_new()
+        self.nvars = 0
+
+    def __del__(self) -> None:
+        try:
+            if self._h:
+                self._lib.mtpu_sat_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def new_var(self) -> int:
+        self.nvars += 1
+        self._lib.mtpu_sat_new_var(self._h)
+        return self.nvars
+
+    def add_clause(self, lits) -> bool:
+        arr = (ctypes.c_int32 * len(lits))(*lits)
+        for l in lits:
+            v = abs(l)
+            if v > self.nvars:
+                self.nvars = v
+        return bool(self._lib.mtpu_sat_add_clause(self._h, arr, len(lits)))
+
+    def solve(self, assumptions=(), timeout: float = 0.0, conflicts: int = 0):
+        """Returns True (sat), False (unsat), or None (budget exhausted)."""
+        arr = (ctypes.c_int32 * len(assumptions))(*assumptions)
+        r = self._lib.mtpu_sat_solve(
+            self._h, arr, len(assumptions), timeout, conflicts
+        )
+        if r == 1:
+            return True
+        if r == 0:
+            return False
+        return None
+
+    def value(self, var: int) -> bool:
+        return self._lib.mtpu_sat_value(self._h, var) == 1
+
+    def stats(self) -> dict:
+        return {
+            "conflicts": self._lib.mtpu_sat_stats(self._h, 0),
+            "propagations": self._lib.mtpu_sat_stats(self._h, 1),
+            "decisions": self._lib.mtpu_sat_stats(self._h, 2),
+        }
