@@ -1,0 +1,57 @@
+"""Expression wrapper: a term plus an annotation set.
+
+Parity with reference mythril/laser/smt/expression.py:10-71 — annotations are
+the taint-tracking payload detectors rely on (e.g. integer overflow taint,
+predictable-value taint); they live on the wrapper, never in the interned DAG,
+and union through every operation.
+"""
+
+from typing import Generic, List, Optional, Set, TypeVar
+
+from . import terms as T
+
+G = TypeVar("G")
+
+
+class Expression(Generic[G]):
+    """Wraps a DAG term and carries annotations."""
+
+    def __init__(self, raw: "T.Term", annotations: Optional[Set] = None):
+        self.raw = raw
+        if annotations is None:
+            self._annotations = set()
+        elif isinstance(annotations, set):
+            self._annotations = annotations
+        else:
+            self._annotations = set(annotations)
+
+    @property
+    def annotations(self) -> Set:
+        return self._annotations
+
+    def annotate(self, annotation) -> None:
+        self._annotations.add(annotation)
+
+    def get_annotations(self, annotation_type: type) -> List:
+        return [a for a in self._annotations if isinstance(a, annotation_type)]
+
+    def __repr__(self) -> str:
+        return repr(self.raw)
+
+    def size(self):
+        w = self.raw.width
+        return w if isinstance(w, int) else None
+
+    def __hash__(self) -> int:
+        return self.raw.tid
+
+
+def simplify(expression: Expression) -> Expression:
+    """Rebuild the term (constructors fold constants / apply local rules).
+
+    Reference parity: mythril/laser/smt/expression.py:62-71.
+    """
+    t = expression.raw
+    simplified = T.substitute_term(t, {})
+    expression.raw = simplified
+    return expression

@@ -1,0 +1,252 @@
+"""Unsigned-interval abstract propagation over the term DAG.
+
+This is the host prototype of the TPU lane pre-filter promised by the build
+plan (SURVEY.md §2.10 solver-level row): before any SAT call, every assertion
+is abstractly evaluated; a must-false assertion proves the path infeasible
+without touching the CDCL core. The same transfer functions are mirrored as
+vectorized jax kernels in mythril_tpu/ops/intervals.py for on-device lane
+pruning.
+
+Domain: [lo, hi] over unsigned width-w integers (no wrap tracking — any
+overflow widens to top). Bools are 3-valued via (may_be_false, may_be_true).
+"""
+
+from typing import Dict, Tuple
+
+from . import terms as T
+
+BoolAbs = Tuple[bool, bool]  # (may_be_false, may_be_true)
+
+
+def _top(w: int) -> Tuple[int, int]:
+    return (0, (1 << w) - 1)
+
+
+def interval(t: "T.Term", memo: Dict[int, object] = None):
+    """Abstract value: (lo, hi) for BV terms, (may_false, may_true) for
+    Bool terms. Arrays/UF applications go to top. Iterative post-order
+    driver (deep chains exceed the recursion limit)."""
+    if memo is None:
+        memo = {}
+    stack = [t]
+    while stack:
+        cur = stack[-1]
+        if cur.tid in memo:
+            stack.pop()
+            continue
+        pending = [a for a in cur.args if a.tid not in memo]
+        if pending:
+            stack.extend(pending)
+            continue
+        stack.pop()
+        memo[cur.tid] = _interval_node(cur, memo)
+    return memo[t.tid]
+
+
+def _interval_node(t: "T.Term", memo):
+    op = t.op
+    w = t.width if isinstance(t.width, int) else 0
+    full = _top(w) if w else None
+    if op == T.BV_CONST:
+        v = (t.val, t.val)
+    elif op == T.TRUE:
+        v = (False, True)
+    elif op == T.FALSE:
+        v = (True, False)
+    elif op in (T.BV_VAR, T.SELECT, T.APPLY):
+        v = full
+    elif op == T.BOOL_VAR:
+        v = (True, True)
+    elif op == T.ADD:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if ahi + bhi < (1 << w):
+            v = (alo + blo, ahi + bhi)
+        else:
+            v = full
+    elif op == T.SUB:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if alo >= bhi:
+            v = (alo - bhi, ahi - blo)
+        else:
+            v = full
+    elif op == T.MUL:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if ahi * bhi < (1 << w):
+            v = (alo * blo, ahi * bhi)
+        else:
+            v = full
+    elif op == T.UDIV:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if blo >= 1:
+            v = (alo // bhi, ahi // blo)
+        else:
+            v = full  # divisor may be 0 -> result may be all-ones
+    elif op == T.UREM:
+        (alo, ahi) = interval(t.args[1], memo)
+        if ahi >= 1:
+            v = (0, ahi - 1) if alo >= 1 else (0, (1 << w) - 1)
+        else:
+            v = interval(t.args[0], memo)  # x % 0 = x
+    elif op == T.BAND:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        v = (0, min(ahi, bhi))
+    elif op == T.BOR:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        hi = (1 << max(ahi.bit_length(), bhi.bit_length())) - 1
+        v = (max(alo, blo), min(hi, (1 << w) - 1))
+    elif op == T.BXOR:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        hi = (1 << max(ahi.bit_length(), bhi.bit_length())) - 1
+        v = (0, min(hi, (1 << w) - 1))
+    elif op == T.BNOT:
+        (alo, ahi) = interval(t.args[0], memo)
+        m = (1 << w) - 1
+        v = (m - ahi, m - alo)
+    elif op == T.NEG:
+        (alo, ahi) = interval(t.args[0], memo)
+        if alo == ahi:
+            nv = (-alo) & ((1 << w) - 1)
+            v = (nv, nv)
+        elif alo >= 1:
+            v = ((1 << w) - ahi, (1 << w) - alo)
+        else:
+            v = full
+    elif op == T.SHL:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if blo == bhi and bhi < w and (ahi << bhi) < (1 << w):
+            v = (alo << blo, ahi << bhi)
+        else:
+            v = full
+    elif op == T.LSHR:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        v = (alo >> min(bhi, w), ahi >> min(blo, w))
+    elif op == T.ASHR:
+        v = full
+    elif op == T.CONCAT:
+        lo = hi = 0
+        for part in t.args:
+            (plo, phi) = interval(part, memo)
+            lo = (lo << part.width) | plo
+            hi = (hi << part.width) | phi
+        v = (lo, hi)
+    elif op == T.EXTRACT:
+        hi_b, lo_b = t.params
+        (alo, ahi) = interval(t.args[0], memo)
+        if ahi >> (hi_b + 1) == alo >> (hi_b + 1):
+            # high bits fixed; slice the shifted interval if it fits
+            slo, shi = alo >> lo_b, ahi >> lo_b
+            m = (1 << (hi_b - lo_b + 1)) - 1
+            if shi - slo <= m and (slo & m) <= (shi & m):
+                v = (slo & m, shi & m)
+            else:
+                v = _top(hi_b - lo_b + 1)
+        else:
+            v = _top(hi_b - lo_b + 1)
+    elif op == T.ZEXT:
+        v = interval(t.args[0], memo)
+    elif op == T.SEXT:
+        (alo, ahi) = interval(t.args[0], memo)
+        iw = t.args[0].width
+        if ahi < (1 << (iw - 1)):  # provably non-negative
+            v = (alo, ahi)
+        else:
+            v = full
+    elif op in (T.ITE,):
+        (mf, mt) = interval(t.args[0], memo)
+        (alo, ahi) = interval(t.args[1], memo)
+        (blo, bhi) = interval(t.args[2], memo)
+        if not mf:
+            v = (alo, ahi)
+        elif not mt:
+            v = (blo, bhi)
+        else:
+            v = (min(alo, blo), max(ahi, bhi))
+    elif op in (T.SDIV, T.SREM):
+        v = full
+    elif op == T.EQ:
+        a, b = t.args
+        if a.is_array or b.is_array:
+            v = (True, True)
+        else:
+            (alo, ahi) = interval(a, memo)
+            (blo, bhi) = interval(b, memo)
+            if ahi < blo or bhi < alo:
+                v = (True, False)  # must be false
+            elif alo == ahi == blo == bhi:
+                v = (False, True)  # must be true
+            else:
+                v = (True, True)
+    elif op == T.ULT:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if ahi < blo:
+            v = (False, True)
+        elif alo >= bhi:
+            v = (True, False)
+        else:
+            v = (True, True)
+    elif op == T.ULE:
+        (alo, ahi) = interval(t.args[0], memo)
+        (blo, bhi) = interval(t.args[1], memo)
+        if ahi <= blo:
+            v = (False, True)
+        elif alo > bhi:
+            v = (True, False)
+        else:
+            v = (True, True)
+    elif op in (T.SLT, T.SLE):
+        v = (True, True)
+    elif op == T.AND:
+        mf, mt = False, True
+        for a in t.args:
+            (f, tt) = interval(a, memo)
+            if not tt:
+                mf, mt = True, False
+                break
+            mf = mf or f
+        v = (mf, mt)
+    elif op == T.OR:
+        mf, mt = True, False
+        for a in t.args:
+            (f, tt) = interval(a, memo)
+            if not f:
+                mf, mt = False, True
+                break
+            mt = mt or tt
+        v = (mf, mt)
+    elif op == T.NOT:
+        (f, tt) = interval(t.args[0], memo)
+        v = (tt, f)
+    elif op == T.XOR:
+        (af, at) = interval(t.args[0], memo)
+        (bf, bt) = interval(t.args[1], memo)
+        v = (at and bt or af and bf, at and bf or af and bt)
+    elif op == T.BOOL_ITE:
+        (cf, ct) = interval(t.args[0], memo)
+        (af, at) = interval(t.args[1], memo)
+        (bf, bt) = interval(t.args[2], memo)
+        mf = (ct and af) or (cf and bf)
+        mt = (ct and at) or (cf and bt)
+        v = (mf, mt)
+    else:
+        v = full if w else (True, True)
+    return v
+
+
+def must_be_false(t: "T.Term", memo=None) -> bool:
+    mf, mt = interval(t, memo)
+    return not mt
+
+
+def must_be_true(t: "T.Term", memo=None) -> bool:
+    mf, mt = interval(t, memo)
+    return not mf

@@ -1,0 +1,405 @@
+"""Word-level decision procedure: preprocess -> interval filter -> bit-blast
+-> native CDCL -> model.
+
+This module is the engine behind the Solver/Optimize facades
+(mythril_tpu/smt/solver/__init__.py), replacing the z3 backend the reference
+uses (reference mythril/laser/smt/solver/solver.py:18-121). Pipeline:
+
+1. flatten conjunctions, constant-fold (already folded at construction);
+2. equality propagation: ``var == const`` / ``var == term`` assertions become
+   substitutions, iterated to fixpoint — this alone discharges most concrete
+   EVM path queries without SAT;
+3. unsigned-interval must-false filter (mythril_tpu/smt/interval.py) — the
+   host twin of the TPU lane pruner;
+4. array/UF elimination by read-over-write reduction (done at construction)
+   plus Ackermann expansion;
+5. bit-blast (mythril_tpu/smt/bitblast.py) onto the native CDCL core with the
+   caller's timeout/conflict budget;
+6. model extraction back through the substitution and Ackermann maps.
+"""
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import terms as T
+from ..bitblast import Blaster
+from ..interval import interval as abs_interval
+from ...native import SatSolver
+
+SAT, UNSAT, UNKNOWN = "sat", "unsat", "unknown"
+
+
+class ModelData:
+    """Concrete assignment extracted from a successful check."""
+
+    def __init__(self):
+        self.bv: Dict[str, int] = {}
+        self.bools: Dict[str, bool] = {}
+        self.arrays: Dict[str, Tuple[int, Dict[int, int]]] = {}
+        self.funcs: Dict[str, Dict[tuple, int]] = {}
+
+    def env(self, complete: bool = True) -> "T.EvalEnv":
+        bv = dict(self.bv)
+        bv.update(self.bools)
+        return T.EvalEnv(bv=bv, arrays=self.arrays, funcs=self.funcs,
+                         complete=complete)
+
+    def eval_term(self, t: "T.Term", complete: bool = True):
+        return T.eval_term(t, self.env(complete=complete))
+
+
+def _flatten(assertions: List["T.Term"]) -> List["T.Term"]:
+    out = []
+    stack = list(assertions)
+    while stack:
+        a = stack.pop()
+        if a.op == T.AND:
+            stack.extend(a.args)
+        else:
+            out.append(a)
+    return out
+
+
+def _equality_propagation(assertions):
+    """Extract var==term substitutions and apply to fixpoint (bounded)."""
+    subs: Dict[int, T.Term] = {}
+    for _ in range(8):
+        new_sub = {}
+        for a in assertions:
+            if a.op != T.EQ:
+                continue
+            x, y = a.args
+            for lhs, rhs in ((x, y), (y, x)):
+                if (
+                    lhs.op == T.BV_VAR
+                    and lhs.tid not in subs
+                    and lhs.tid not in new_sub
+                    and lhs.tid not in _free_var_tids(rhs)
+                ):
+                    new_sub[lhs.tid] = rhs
+                    break
+        if not new_sub:
+            break
+        memo: Dict[int, T.Term] = {}
+        assertions = [T.substitute_term(a, new_sub, memo) for a in assertions]
+        subs = {
+            k: T.substitute_term(v, new_sub, memo) for k, v in subs.items()
+        }
+        subs.update(new_sub)
+        if all(a.op == T.TRUE for a in assertions):
+            break
+    return assertions, subs
+
+
+_FREE_CACHE: Dict[int, frozenset] = {}
+
+
+def _free_var_tids(t: "T.Term") -> frozenset:
+    stack = [t]
+    while stack:
+        cur = stack[-1]
+        if cur.tid in _FREE_CACHE:
+            stack.pop()
+            continue
+        if cur.op in (T.BV_VAR, T.BOOL_VAR, T.ARRAY_VAR):
+            _FREE_CACHE[cur.tid] = frozenset((cur.tid,))
+            stack.pop()
+            continue
+        pending = [a for a in cur.args if a.tid not in _FREE_CACHE]
+        if pending:
+            stack.extend(pending)
+            continue
+        stack.pop()
+        if not cur.args:
+            _FREE_CACHE[cur.tid] = frozenset()
+        else:
+            _FREE_CACHE[cur.tid] = frozenset().union(
+                *(_FREE_CACHE[a.tid] for a in cur.args)
+            )
+    return _FREE_CACHE[t.tid]
+
+
+def _ackermannize(assertions):
+    """Replace SELECT/APPLY instances with fresh vars + congruence axioms.
+
+    Returns (new_assertions, select_map, apply_map) where
+    select_map: base array name -> list[(idx_term, fresh_var_term)]
+    apply_map:  func name -> list[(args_terms, fresh_var_term)]
+    """
+    select_map: Dict[str, list] = {}
+    apply_map: Dict[str, list] = {}
+    counter = [0]
+
+    def process(t_list):
+        # repeatedly eliminate innermost select/apply nodes
+        out = list(t_list)
+        extra: List[T.Term] = []
+        for _ in range(64):
+            targets = []
+            seen = set()
+            for a in out + extra:
+                T.collect(
+                    a,
+                    lambda x: x.op in (T.SELECT, T.APPLY),
+                    targets,
+                    seen,
+                )
+            # innermost only: none of my args contain select/apply
+            def innermost(x):
+                return not any(
+                    T.collect(arg, lambda y: y.op in (T.SELECT, T.APPLY))
+                    for arg in x.args
+                )
+
+            inner = [x for x in targets if innermost(x)]
+            if not inner:
+                break
+            mapping = {}
+            for x in inner:
+                counter[0] += 1
+                fresh = T.bv_var(f"__ack_{counter[0]}", x.width)
+                mapping[x.tid] = fresh
+                if x.op == T.SELECT:
+                    base = x.args[0]
+                    # walk store chain: mk_select already reduced stores,
+                    # so base is ARRAY_VAR or CONST_ARRAY
+                    if base.op == T.CONST_ARRAY:
+                        extra.append(T.mk_eq(fresh, base.args[0]))
+                        continue
+                    name = base.name
+                    entry = (x.args[1], fresh)
+                    for (idx2, var2) in select_map.get(name, ()):
+                        extra.append(
+                            T.mk_bool_or(
+                                T.mk_not(T.mk_eq(x.args[1], idx2)),
+                                T.mk_eq(fresh, var2),
+                            )
+                        )
+                    select_map.setdefault(name, []).append(entry)
+                else:
+                    name = x.name
+                    entry = (x.args, fresh)
+                    for (args2, var2) in apply_map.get(name, ()):
+                        hyp = [
+                            T.mk_not(T.mk_eq(a1, a2))
+                            for a1, a2 in zip(x.args, args2)
+                        ]
+                        extra.append(
+                            T.mk_bool_or(*hyp, T.mk_eq(fresh, var2))
+                        )
+                    apply_map.setdefault(name, []).append(entry)
+            memo: Dict[int, T.Term] = {}
+            out = [T.substitute_term(a, mapping, memo) for a in out]
+            extra = [T.substitute_term(a, mapping, memo) for a in extra]
+            for name in select_map:
+                select_map[name] = [
+                    (T.substitute_term(i, mapping, memo), v)
+                    for (i, v) in select_map[name]
+                ]
+            for name in apply_map:
+                apply_map[name] = [
+                    (
+                        tuple(T.substitute_term(a, mapping, memo) for a in ags),
+                        v,
+                    )
+                    for (ags, v) in apply_map[name]
+                ]
+        return out + extra
+
+    return process(assertions), select_map, apply_map
+
+
+class CheckContext:
+    """One check() invocation; retains blaster for model extraction."""
+
+    def __init__(self):
+        self.status = UNKNOWN
+        self.model: Optional[ModelData] = None
+        self.stats = {}
+
+
+def check(
+    assertions: List["T.Term"],
+    timeout_s: float = 10.0,
+    conflict_budget: int = 0,
+    minimize: List["T.Term"] = (),
+    maximize: List["T.Term"] = (),
+) -> CheckContext:
+    """Decide conjunction of Bool terms; optionally lexicographically
+    minimize the given BV terms (used by Optimize for tx-sequence
+    minimization, reference analysis/solver.py:222-259)."""
+    ctx = CheckContext()
+    t0 = time.monotonic()
+    work = _flatten(assertions)
+    if any(a.op == T.FALSE for a in work):
+        ctx.status = UNSAT
+        return ctx
+    work = [a for a in work if a.op != T.TRUE]
+
+    work, subs = _equality_propagation(work)
+    if any(a.op == T.FALSE for a in work):
+        ctx.status = UNSAT
+        return ctx
+    work = [a for a in work if a.op != T.TRUE]
+
+    # interval pre-filter (host twin of the TPU lane pruner)
+    memo: Dict[int, object] = {}
+    for a in work:
+        mf, mt = abs_interval(a, memo)
+        if not mt:
+            ctx.status = UNSAT
+            return ctx
+
+    work, select_map, apply_map = _ackermannize(work)
+    work = [a for a in work if a.op != T.TRUE]
+    if any(a.op == T.FALSE for a in work):
+        ctx.status = UNSAT
+        return ctx
+
+    sat = SatSolver()
+    blaster = Blaster(sat)
+    for a in work:
+        blaster.assert_term(a)
+
+    remaining = timeout_s - (time.monotonic() - t0)
+    if remaining <= 0:
+        ctx.status = UNKNOWN
+        return ctx
+    res = sat.solve(timeout=remaining, conflicts=conflict_budget)
+    if res is None:
+        ctx.status = UNKNOWN
+        return ctx
+    if res is False:
+        ctx.status = UNSAT
+        return ctx
+
+    # SAT: optional lexicographic optimization of objectives (MSB->LSB)
+    if minimize or maximize:
+        if not _optimize_objectives(
+            blaster, sat, minimize, maximize, subs, timeout_s, t0
+        ):
+            # no satisfying assignment could be restored within budget
+            ctx.status = UNKNOWN
+            return ctx
+
+    ctx.status = SAT
+    ctx.model = _extract_model(blaster, sat, subs, select_map, apply_map)
+    ctx.stats = sat.stats()
+    return ctx
+
+
+def _optimize_objectives(blaster, sat, minimize, maximize, subs, timeout_s,
+                         t0):
+    """Greedy bitwise lexicographic optimization under assumptions.
+
+    Invariant restored on every exit path: the SAT core holds a satisfying
+    assignment for the original constraints (a failed/aborted probe calls
+    cancel_until and would otherwise leave a garbage model behind)."""
+    fixed: List[int] = []
+    objectives = [(obj, False) for obj in minimize] + [
+        (obj, True) for obj in maximize
+    ]
+    for obj, maximizing in objectives:
+        obj_sub = T.substitute_term(obj, subs)
+        if obj_sub.op == T.BV_CONST:
+            continue
+        try:
+            bits = blaster.bits(obj_sub)
+        except NotImplementedError:
+            continue  # objective contains arrays not present in constraints
+        for l in reversed(bits):  # MSB first
+            want = l if maximizing else -l
+            if blaster.is_true(l) or blaster.is_false(l):
+                continue
+            remaining = timeout_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                break
+            r = sat.solve(
+                assumptions=fixed + [want], timeout=remaining, conflicts=20000
+            )
+            if r is True:
+                fixed.append(want)
+            elif r is False:
+                fixed.append(-want)
+            else:
+                break
+    # restore a model consistent with whatever got fixed; fall back to the
+    # unconstrained problem if even that probe is over budget
+    r = sat.solve(
+        assumptions=fixed, timeout=max(1.0, timeout_s - (time.monotonic() - t0))
+    )
+    if r is not True:
+        r = sat.solve(timeout=max(1.0, timeout_s - (time.monotonic() - t0)))
+    return r is True
+
+
+def _extract_model(blaster, sat, subs, select_map, apply_map) -> ModelData:
+    md = ModelData()
+    # blasted variables
+    for key, bits in list(blaster._bv.items()):
+        if not isinstance(key, int):
+            continue
+        t = _term_by_tid(key)
+        if t is not None and t.op == T.BV_VAR and not t.name.startswith(
+            "__ack_"
+        ):
+            md.bv[t.name] = blaster.model_value(t)
+    for key, lit in list(blaster._bool.items()):
+        t = _term_by_tid(key)
+        if t is not None and t.op == T.BOOL_VAR:
+            md.bools[t.name] = bool(blaster.model_value(t))
+    env = T.EvalEnv(bv=dict(md.bv, **md.bools), arrays=md.arrays,
+                    funcs=md.funcs, complete=True)
+    # arrays from ackermann select instances (before subs eval: rhs terms may
+    # contain selects which eval_term resolves through env.arrays)
+    for name, entries in select_map.items():
+        table: Dict[int, int] = {}
+        for idx_t, var_t in entries:
+            if idx_t.tid in blaster._bv:
+                idx_v = blaster.model_value(idx_t)
+            else:
+                idx_v = T.eval_term(idx_t, env)
+            if var_t.tid in blaster._bv:
+                val_v = blaster.model_value(var_t)
+            else:
+                val_v = 0
+            table.setdefault(idx_v, val_v)
+        md.arrays[name] = (0, table)
+    for name, entries in apply_map.items():
+        table2: Dict[tuple, int] = {}
+        for args_t, var_t in entries:
+            key2 = tuple(
+                blaster.model_value(a)
+                if a.tid in blaster._bv
+                else T.eval_term(a, env)
+                for a in args_t
+            )
+            val = (
+                blaster.model_value(var_t) if var_t.tid in blaster._bv else 0
+            )
+            table2.setdefault(key2, val)
+        md.funcs[name] = table2
+    # substitution-derived values (vars eliminated before blasting)
+    for tid, rhs in subs.items():
+        t = _term_by_tid(tid)
+        if t is None or t.op != T.BV_VAR:
+            continue
+        try:
+            # rhs may contain blasted vars; evaluate via blaster when present
+            if rhs.tid in blaster._bv:
+                md.bv[t.name] = blaster.model_value(rhs)
+            else:
+                md.bv[t.name] = T.eval_term(rhs, env)
+        except Exception:
+            md.bv[t.name] = 0
+    return md
+
+
+_TID_INDEX: Dict[int, "T.Term"] = {}
+
+
+def _term_by_tid(tid: int) -> Optional["T.Term"]:
+    if len(_TID_INDEX) != T.dag_size():
+        for t in T._table.values():
+            _TID_INDEX[t.tid] = t
+    return _TID_INDEX.get(tid)

@@ -1,0 +1,104 @@
+"""Cross-cutting utilities (reference parity:
+mythril/support/support_utils.py:14-101): Singleton metaclass, LRU cache,
+model quick-sat cache, and the keccak entry point (backed by the native
+library instead of the eth-hash wheel)."""
+
+import logging
+from collections import OrderedDict
+from typing import Dict
+
+log = logging.getLogger(__name__)
+
+
+class Singleton(type):
+    """A metaclass type implementing the singleton pattern.
+
+    Like the reference (support_utils.py:21-23) this is not thread- or
+    process-safe; per-run context objects own all engine state, this is only
+    used for process-global knobs (Args, statistics, signature DB).
+    """
+
+    _instances: Dict = {}
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            cls._instances[cls] = super(Singleton, cls).__call__(
+                *args, **kwargs
+            )
+        return cls._instances[cls]
+
+
+class LRUCache:
+    """Simple ordered-dict LRU (reference support_utils.py:34-52)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self.lru_cache: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        try:
+            value = self.lru_cache.pop(key)
+            self.lru_cache[key] = value
+            return value
+        except KeyError:
+            return None
+
+    def put(self, key, value):
+        try:
+            self.lru_cache.pop(key)
+        except KeyError:
+            if len(self.lru_cache) >= self.size:
+                self.lru_cache.popitem(last=False)
+        self.lru_cache[key] = value
+
+
+class ModelCache:
+    """Caches recent models; quick-sat re-evaluates a constraint under cached
+    models before invoking the solver (reference support_utils.py:55-68)."""
+
+    def __init__(self):
+        self.model_cache = LRUCache(size=100)
+
+    def check_quick_sat(self, constraint_term) -> object:
+        for model in reversed(self.model_cache.lru_cache.keys()):
+            try:
+                result = model.raw[0].eval_term(constraint_term,
+                                                complete=False)
+            except Exception:
+                continue
+            if result is True:
+                self.model_cache.put(model, 1)
+                return model
+        return None
+
+    def put(self, model, weight) -> None:
+        self.model_cache.put(model, weight)
+
+
+def get_code_hash(code) -> str:
+    """Keccak hash of hex bytecode string (reference support_utils.py:71-88)."""
+    from ..native import keccak256
+
+    if isinstance(code, str):
+        code = code.replace("0x", "")
+        try:
+            hash_ = keccak256(bytes.fromhex(code))
+            return "0x" + hash_.hex()
+        except ValueError:
+            log.debug("invalid code hex: %s", code[:40])
+            return ""
+    return "0x" + keccak256(bytes(code)).hex()
+
+
+def sha3(value: bytes) -> bytes:
+    """Concrete keccak-256 (reference support_utils.py:94-101)."""
+    if isinstance(value, str):
+        value = value.encode()
+    from ..native import keccak256
+
+    return keccak256(value)
+
+
+def zpad(x: bytes, l: int) -> bytes:
+    """Left zero pad value `x` at least to length `l`."""
+    return b"\x00" * max(0, l - len(x)) + x

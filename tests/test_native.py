@@ -1,0 +1,89 @@
+"""Native layer: keccak vectors and SAT solver behavior."""
+
+import itertools
+import random
+
+from mythril_tpu.native import SatSolver, keccak256
+
+
+def test_keccak_vectors():
+    assert (
+        keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    assert keccak256(b"transfer(address,uint256)")[:4].hex() == "a9059cbb"
+
+
+def test_keccak_rate_boundaries():
+    # deterministic across the 136-byte rate boundary
+    for n in (135, 136, 137, 272):
+        d = bytes(range(256))[:0] + (b"\x5a" * n)
+        assert keccak256(d) == keccak256(bytes(d))
+
+
+def test_sat_basic_unsat():
+    s = SatSolver()
+    a, b, c = s.new_var(), s.new_var(), s.new_var()
+    s.add_clause([a, b])
+    s.add_clause([-a, c])
+    s.add_clause([-b, c])
+    s.add_clause([-c])
+    assert s.solve() is False
+    # repeated solve after UNSAT must stay UNSAT (soundness regression)
+    assert s.solve() is False
+
+
+def test_sat_pigeonhole():
+    s = SatSolver()
+    holes, pigeons = 4, 5
+    P = [[s.new_var() for _ in range(holes)] for _ in range(pigeons)]
+    for p in range(pigeons):
+        s.add_clause(P[p])
+    for h in range(holes):
+        for p1, p2 in itertools.combinations(range(pigeons), 2):
+            s.add_clause([-P[p1][h], -P[p2][h]])
+    assert s.solve() is False
+
+
+def test_sat_models_valid():
+    random.seed(7)
+    for _ in range(10):
+        s = SatSolver()
+        n = 40
+        vs = [s.new_var() for _ in range(n)]
+        clauses = []
+        for _ in range(140):
+            lits = [
+                random.choice([1, -1]) * random.choice(vs) for _ in range(3)
+            ]
+            clauses.append(lits)
+            s.add_clause(lits)
+        if s.solve():
+            for lits in clauses:
+                assert any((l > 0) == s.value(abs(l)) for l in lits)
+
+
+def test_sat_assumptions():
+    s = SatSolver()
+    x, y = s.new_var(), s.new_var()
+    s.add_clause([x, y])
+    assert s.solve(assumptions=[-x, -y]) is False
+    assert s.solve(assumptions=[-x]) is True
+    assert s.value(y) is True
+    assert s.solve() is True
+
+
+def test_sat_budget_returns_unknown():
+    s = SatSolver()
+    holes, pigeons = 9, 10
+    P = [[s.new_var() for _ in range(holes)] for _ in range(pigeons)]
+    for p in range(pigeons):
+        s.add_clause(P[p])
+    for h in range(holes):
+        for p1, p2 in itertools.combinations(range(pigeons), 2):
+            s.add_clause([-P[p1][h], -P[p2][h]])
+    assert s.solve(conflicts=20) is None

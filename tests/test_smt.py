@@ -1,0 +1,229 @@
+"""SMT layer: facade API, decision procedure, arrays, UFs, models.
+
+Mirrors the reference's SMT test intent (reference tests/laser/smt/) but
+targets this build's own backend."""
+
+import random
+
+import mythril_tpu.smt.terms as T
+from mythril_tpu.smt import (
+    And,
+    Array,
+    BVAddNoOverflow,
+    BVMulNoOverflow,
+    Concat,
+    Extract,
+    Function,
+    If,
+    IndependenceSolver,
+    K,
+    LShR,
+    Not,
+    Optimize,
+    Or,
+    Solver,
+    UDiv,
+    UGT,
+    ULT,
+    URem,
+    sat,
+    simplify,
+    symbol_factory as sf,
+    unsat,
+)
+
+
+def test_arith_model():
+    x = sf.BitVecSym("tx", 256)
+    s = Solver()
+    s.add(x + 5 == 12)
+    assert s.check() == sat
+    assert s.model().eval(x, True).value == 7
+
+
+def test_unsigned_range_unsat():
+    x = sf.BitVecSym("tu", 256)
+    s = Solver()
+    s.add(ULT(x, sf.BitVecVal(5, 256)), UGT(x, sf.BitVecVal(10, 256)))
+    assert s.check() == unsat
+
+
+def test_signed_vs_unsigned():
+    x = sf.BitVecSym("ts", 256)
+    s = Solver()
+    # -1 (all ones) is < 0 signed but > 100 unsigned
+    s.add(x < 0, UGT(x, sf.BitVecVal(100, 256)))
+    assert s.check() == sat
+
+
+def test_overflow_predicates():
+    x = sf.BitVecSym("to1", 256)
+    y = sf.BitVecSym("to2", 256)
+    s = Solver()
+    s.add(Not(BVMulNoOverflow(x, y, False)), x == 2)
+    assert s.check() == sat
+    yv = s.model().eval(y, True).value
+    assert 2 * yv >= 2**256
+
+    s = Solver()
+    s.add(Not(BVAddNoOverflow(x, sf.BitVecVal(1, 256), False)))
+    assert s.check() == sat
+    assert s.model().eval(x, True).value == 2**256 - 1
+
+
+def test_calldata_selector_array():
+    cd = Array("cd_t", 256, 8)
+    sel = Concat(cd[0], cd[1], cd[2], cd[3])
+    s = Solver()
+    s.add(sel == 0xA9059CBB)
+    assert s.check() == sat
+    m = s.model()
+    assert [m.eval(cd[i], True).value for i in range(4)] == [
+        0xA9, 0x05, 0x9C, 0xBB,
+    ]
+
+
+def test_array_store_and_conflict():
+    st = Array("st_t", 256, 256)
+    st[sf.BitVecVal(3, 256)] = sf.BitVecVal(99, 256)
+    s = Solver()
+    s.add(st[3] == 99)
+    assert s.check() == sat
+    s = Solver()
+    s.add(st[3] == 98)
+    assert s.check() == unsat
+    idx = sf.BitVecSym("st_idx", 256)
+    cd = Array("st_cd", 256, 8)
+    s = Solver()
+    s.add(cd[idx] == 5, cd[0] == 7, idx == 0)
+    assert s.check() == unsat
+
+
+def test_const_array():
+    k = K(256, 256, 0)
+    s = Solver()
+    s.add(k[12345] == 0)
+    assert s.check() == sat
+    s = Solver()
+    s.add(k[12345] == 1)
+    assert s.check() == unsat
+
+
+def test_uf_congruence():
+    f = Function("t_keccak", 512, 256)
+    a = sf.BitVecSym("uf_a", 512)
+    b = sf.BitVecSym("uf_b", 512)
+    s = Solver()
+    s.add(a == b, f(a) != f(b))
+    assert s.check() == unsat
+    s = Solver()
+    s.add(f(a) != f(b))
+    assert s.check() == sat
+
+
+def test_differential_eval():
+    random.seed(3)
+    for trial in range(10):
+        x = sf.BitVecSym(f"df_{trial}", 256)
+        c = random.getrandbits(64)
+        t = ((x * 3) + c) ^ (x & 0xFFFF)
+        assign = random.getrandbits(256)
+        expected = T.eval_term(t.raw, T.EvalEnv(bv={f"df_{trial}": assign}))
+        s = Solver()
+        s.add(x == assign, t == expected)
+        assert s.check() == sat
+        s = Solver()
+        s.add(x == assign, t != expected)
+        assert s.check() == unsat
+
+
+def test_independence_solver_buckets():
+    a = sf.BitVecSym("is_a", 256)
+    b = sf.BitVecSym("is_b", 256)
+    s = IndependenceSolver()
+    s.add(a == 1, b == 2)
+    assert s.check() == sat
+    m = s.model()
+    assert m.eval(a, True).value == 1
+    assert m.eval(b, True).value == 2
+    s = IndependenceSolver()
+    s.add(a == 1, a == 2, b == 3)
+    assert s.check() == unsat
+
+
+def test_optimize_minimize():
+    x = sf.BitVecSym("om_x", 256)
+    s = Optimize()
+    s.add(UGT(x, sf.BitVecVal(5, 256)))
+    s.minimize(x)
+    assert s.check() == sat
+    assert s.model().eval(x, True).value == 6
+
+
+def test_annotations_propagate():
+    x = sf.BitVecSym("an_x", 256, annotations={"taint"})
+    y = x + 1
+    assert "taint" in y.annotations
+    z = If(y == 2, y, sf.BitVecVal(0, 256))
+    assert "taint" in z.annotations
+
+
+def test_simplify_folds():
+    x = sf.BitVecSym("si_x", 256)
+    e = (x + 0) * 1
+    assert simplify(e).raw is x.raw
+
+
+def test_deep_term_chain_no_recursion_error():
+    # folding chain: collapses at construction
+    x = sf.BitVecSym("deep_x", 256)
+    t = x
+    for i in range(5000):
+        t = t + 1
+    assert t.raw.args and (t.raw.op == "add")  # folded to x + 5000
+    s = Solver()
+    s.add(t == 5000)
+    assert s.check() == sat
+    assert s.model().eval(x, True).value == 0
+    # non-folding chain: exercises iterative traversal + blasting
+    y = sf.BitVecSym("deep_y", 256)
+    t = y
+    for i in range(600):
+        t = (t ^ 1) + 1
+    val = T.eval_term(t.raw, T.EvalEnv(bv={"deep_y": 7}))
+    s = Solver()
+    s.set_timeout(60000)
+    s.add(t == val, y == 7)
+    assert s.check() == sat
+    # deep eval/substitute only (depth 20000)
+    t2 = y
+    for i in range(20000):
+        t2 = t2 ^ (i | 1)
+    T.eval_term(t2.raw, T.EvalEnv(bv={"deep_y": 3}))
+    T.substitute_term(t2.raw, {y.raw.tid: sf.BitVecVal(1, 256).raw})
+
+
+def test_pop_zero_is_noop():
+    x = sf.BitVecSym("pz_x", 256)
+    s = Solver()
+    s.add(x == 3)
+    s.pop(0)
+    assert s.check() == sat
+    assert s.model().eval(x, True).value == 3
+
+
+def test_optimize_maximize():
+    x = sf.BitVecSym("omx_x", 256)
+    s = Optimize()
+    s.add(ULT(x, sf.BitVecVal(100, 256)))
+    s.maximize(x)
+    assert s.check() == sat
+    assert s.model().eval(x, True).value == 99
+
+
+def test_if_mixed_bool_bitvec():
+    x = sf.BitVecSym("ifm_x", 256)
+    r = If(x == 1, sf.BitVecVal(7, 256), 0)
+    assert r.size() == 256
+    r2 = If(x == 1, 1, sf.BitVecVal(0, 256))
+    assert r2.size() == 256
