@@ -1,0 +1,51 @@
+"""EXP modeling via an uninterpreted Power function with concrete 256^i
+axioms (capability parity:
+mythril/laser/ethereum/function_managers/exponent_function_manager.py:10-63).
+"""
+
+import logging
+from typing import Tuple
+
+from ...smt import And, BitVec, Bool, Function, URem, symbol_factory
+
+log = logging.getLogger(__name__)
+
+
+class ExponentFunctionManager:
+    def __init__(self):
+        power = Function("Power", [256, 256], 256)
+        number_256 = symbol_factory.BitVecVal(256, 256)
+        self.concrete_constraints = And(
+            *[
+                power(number_256, symbol_factory.BitVecVal(i, 256))
+                == symbol_factory.BitVecVal(256**i, 256)
+                for i in range(0, 32)
+            ]
+        )
+
+    def create_condition(self, base: BitVec,
+                         exponent: BitVec) -> Tuple[BitVec, Bool]:
+        power = Function("Power", [256, 256], 256)
+        exponentiation = power(base, exponent)
+
+        if exponent.symbolic is False and base.symbolic is False:
+            const_exponentiation = symbol_factory.BitVecVal(
+                pow(base.value, exponent.value, 2**256),
+                256,
+                annotations=base.annotations.union(exponent.annotations),
+            )
+            constraint = const_exponentiation == exponentiation
+            return const_exponentiation, constraint
+
+        constraint = exponentiation > 0
+        constraint = And(constraint, self.concrete_constraints)
+        if base.value == 256:
+            constraint = And(
+                constraint,
+                power(base, URem(exponent, symbol_factory.BitVecVal(32, 256)))
+                == power(base, exponent),
+            )
+        return exponentiation, constraint
+
+
+exponent_function_manager = ExponentFunctionManager()

@@ -1,0 +1,154 @@
+"""Keccak modeling: per-width uninterpreted functions with inverse axioms and
+disjoint output intervals (capability parity:
+mythril/laser/ethereum/function_managers/keccak_function_manager.py:25-180;
+scheme from the VerX paper).
+
+Properties encoded per symbolic input x of width w:
+- inverse(keccak_w(x)) == x  (injectivity);
+- keccak_w(x) lies in a per-width disjoint interval of the 256-bit space,
+  and is ≡ 0 mod 64 (spreads hashes for mapping/array slots);
+- or keccak_w(x) equals a known concrete hash when x equals that concrete
+  input.
+Concrete inputs are hashed for real with the native keccak.
+"""
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ...smt import (
+    And,
+    BitVec,
+    Bool,
+    Function,
+    Or,
+    ULE,
+    ULT,
+    URem,
+    symbol_factory,
+)
+from ...support.support_utils import sha3
+
+TOTAL_PARTS = 10**40
+PART = (2**256 - 1) // TOTAL_PARTS
+INTERVAL_DIFFERENCE = 10**30
+log = logging.getLogger(__name__)
+
+
+class KeccakFunctionManager:
+    hash_matcher = "fffffff"  # usual prefix of interval-placeholder hashes
+
+    def __init__(self):
+        self.store_function: Dict[int, Tuple[Function, Function]] = {}
+        self.interval_hook_for_size: Dict[int, int] = {}
+        self._index_counter = TOTAL_PARTS - 34534
+        self.hash_result_store: Dict[int, List[BitVec]] = {}
+        self.quick_inverse: Dict[BitVec, BitVec] = {}  # for VM test replay
+        self.concrete_hashes: Dict[BitVec, BitVec] = {}
+        self.symbolic_inputs: Dict[int, List[BitVec]] = {}
+
+    def reset(self):
+        self.__init__()
+
+    @staticmethod
+    def find_concrete_keccak(data: BitVec) -> BitVec:
+        return symbol_factory.BitVecVal(
+            int.from_bytes(
+                sha3(data.value.to_bytes(data.size() // 8, byteorder="big")),
+                "big",
+            ),
+            256,
+        )
+
+    def get_function(self, length: int) -> Tuple[Function, Function]:
+        try:
+            func, inverse = self.store_function[length]
+        except KeyError:
+            func = Function("keccak256_{}".format(length), [length], 256)
+            inverse = Function("keccak256_{}-1".format(length), [256], length)
+            self.store_function[length] = (func, inverse)
+            self.hash_result_store[length] = []
+        return func, inverse
+
+    @staticmethod
+    def get_empty_keccak_hash() -> BitVec:
+        val = int.from_bytes(sha3(b""), "big")
+        return symbol_factory.BitVecVal(val, 256)
+
+    def create_keccak(self, data: BitVec) -> BitVec:
+        length = data.size()
+        func, _ = self.get_function(length)
+
+        if data.symbolic is False:
+            concrete_hash = self.find_concrete_keccak(data)
+            self.concrete_hashes[data] = concrete_hash
+            return concrete_hash
+
+        self.symbolic_inputs.setdefault(length, []).append(data)
+        self.hash_result_store[length].append(func(data))
+        return func(data)
+
+    def create_conditions(self) -> Bool:
+        condition = symbol_factory.Bool(True)
+        for inputs_list in self.symbolic_inputs.values():
+            for symbolic_input in inputs_list:
+                condition = And(
+                    condition,
+                    self._create_condition(func_input=symbolic_input),
+                )
+        for concrete_input, concrete_hash in self.concrete_hashes.items():
+            func, inverse = self.get_function(concrete_input.size())
+            condition = And(
+                condition,
+                func(concrete_input) == concrete_hash,
+                inverse(func(concrete_input)) == concrete_input,
+            )
+        return condition
+
+    def get_concrete_hash_data(self, model) -> Dict[int, List[Optional[int]]]:
+        """Concrete hash values under a model, per input width."""
+        concrete_hashes: Dict[int, List[Optional[int]]] = {}
+        for size in self.hash_result_store:
+            concrete_hashes[size] = []
+            for val in self.hash_result_store[size]:
+                eval_ = model.eval(val, model_completion=False)
+                if eval_ is None:
+                    continue
+                concrete_val = eval_.value
+                if concrete_val is not None:
+                    concrete_hashes[size].append(concrete_val)
+        return concrete_hashes
+
+    def _create_condition(self, func_input: BitVec) -> Bool:
+        length = func_input.size()
+        func, inv = self.get_function(length)
+        try:
+            index = self.interval_hook_for_size[length]
+        except KeyError:
+            self.interval_hook_for_size[length] = self._index_counter
+            index = self._index_counter
+            self._index_counter -= INTERVAL_DIFFERENCE
+
+        lower_bound = index * PART
+        upper_bound = lower_bound + PART
+
+        cond = And(
+            inv(func(func_input)) == func_input,
+            ULE(
+                symbol_factory.BitVecVal(lower_bound, 256), func(func_input)
+            ),
+            ULT(
+                func(func_input), symbol_factory.BitVecVal(upper_bound, 256)
+            ),
+            URem(func(func_input), symbol_factory.BitVecVal(64, 256)) == 0,
+        )
+        concrete_cond = symbol_factory.Bool(False)
+        for key, keccak in self.concrete_hashes.items():
+            if key.size() == func_input.size():
+                hash_eq = And(func(func_input) == keccak, key == func_input)
+                concrete_cond = Or(concrete_cond, hash_eq)
+        return And(
+            inv(func(func_input)) == func_input, Or(cond, concrete_cond)
+        )
+
+
+keccak_function_manager = KeccakFunctionManager()

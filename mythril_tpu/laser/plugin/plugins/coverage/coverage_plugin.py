@@ -1,0 +1,94 @@
+"""Instruction coverage plugin (capability parity:
+mythril/laser/plugin/plugins/coverage/coverage_plugin.py:20-115)."""
+
+import logging
+from typing import Dict, List, Tuple
+
+from ....state.global_state import GlobalState
+from ...builder import PluginBuilder
+from ...interface import LaserPlugin
+
+log = logging.getLogger(__name__)
+
+
+class CoveragePluginBuilder(PluginBuilder):
+    name = "coverage"
+
+    def __call__(self, *args, **kwargs):
+        return InstructionCoveragePlugin()
+
+
+class InstructionCoveragePlugin(LaserPlugin):
+    """Measures instruction coverage: executed / total instructions per
+    bytecode."""
+
+    def __init__(self):
+        self.coverage: Dict[str, Tuple[int, List[bool]]] = {}
+        self.initial_coverage = 0
+        self.tx_id = 0
+
+    def initialize(self, symbolic_vm):
+        self.coverage = {}
+        self.initial_coverage = 0
+        self.tx_id = 0
+
+        @symbolic_vm.laser_hook("stop_sym_exec")
+        def stop_sym_exec_hook():
+            for code, code_cov in self.coverage.items():
+                if sum(code_cov[1]) == 0 and code_cov[0] == 0:
+                    cov_percentage = 0.0
+                else:
+                    cov_percentage = (
+                        sum(code_cov[1]) / float(code_cov[0]) * 100
+                    )
+                string_code = code
+                if type(code) == tuple:
+                    try:
+                        string_code = bytearray(code).hex()
+                    except TypeError:
+                        string_code = "<symbolic code>"
+                log.info(
+                    "Achieved %.2f%% coverage for code: %s",
+                    cov_percentage,
+                    string_code,
+                )
+
+        @symbolic_vm.laser_hook("execute_state")
+        def execute_state_hook(global_state: GlobalState):
+            code = global_state.environment.code.bytecode
+            if code not in self.coverage.keys():
+                number_of_instructions = len(
+                    global_state.environment.code.instruction_list
+                )
+                self.coverage[code] = (
+                    number_of_instructions,
+                    [False] * number_of_instructions,
+                )
+            if global_state.mstate.pc >= len(self.coverage[code][1]):
+                return
+            self.coverage[code][1][global_state.mstate.pc] = True
+
+        @symbolic_vm.laser_hook("start_sym_trans")
+        def execute_start_sym_trans_hook():
+            self.initial_coverage = self._get_covered_instructions()
+
+        @symbolic_vm.laser_hook("stop_sym_trans")
+        def execute_stop_sym_trans_hook():
+            end_coverage = self._get_covered_instructions()
+            log.info(
+                "Number of new instructions covered in tx %d: %d",
+                self.tx_id,
+                end_coverage - self.initial_coverage,
+            )
+            self.tx_id += 1
+
+    def _get_covered_instructions(self) -> int:
+        return sum(sum(cv[1]) for cv in self.coverage.values())
+
+    def is_instruction_covered(self, bytecode, index):
+        if bytecode not in self.coverage.keys():
+            return False
+        try:
+            return self.coverage[bytecode][1][index]
+        except IndexError:
+            return False

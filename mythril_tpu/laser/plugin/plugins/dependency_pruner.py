@@ -1,0 +1,228 @@
+"""Dependency pruner plugin (capability parity:
+mythril/laser/plugin/plugins/dependency_pruner.py:80-308).
+
+Builds per-basic-block read/write/call dependency maps across transactions;
+from transaction 2 on, a previously-seen block only executes when a storage
+slot it (or its path) reads may intersect a slot written in the previous
+transaction (solver-checked)."""
+
+import logging
+from typing import Dict, List, Set
+
+from ....exceptions import UnsatError
+from ....support.model import get_model
+from ...state.global_state import GlobalState
+from ...transaction.transaction_models import ContractCreationTransaction
+from ..builder import PluginBuilder
+from ..interface import LaserPlugin
+from ..signals import PluginSkipState
+from .plugin_annotations import DependencyAnnotation, WSDependencyAnnotation
+
+log = logging.getLogger(__name__)
+
+
+def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
+    annotations = list(state.get_annotations(DependencyAnnotation))
+    if len(annotations) == 0:
+        # carry over the annotation stacked on the world state by the
+        # previous transaction's end states
+        try:
+            world_state_annotation = get_ws_dependency_annotation(state)
+            annotation = world_state_annotation.annotations_stack.pop()
+        except IndexError:
+            annotation = DependencyAnnotation()
+        state.annotate(annotation)
+    else:
+        annotation = annotations[0]
+    return annotation
+
+
+def get_ws_dependency_annotation(state: GlobalState
+                                 ) -> WSDependencyAnnotation:
+    annotations = list(
+        state.world_state.get_annotations(WSDependencyAnnotation)
+    )
+    if len(annotations) == 0:
+        annotation = WSDependencyAnnotation()
+        state.world_state.annotate(annotation)
+    else:
+        annotation = annotations[0]
+    return annotation
+
+
+class DependencyPrunerBuilder(PluginBuilder):
+    name = "dependency-pruner"
+
+    def __call__(self, *args, **kwargs):
+        return DependencyPruner()
+
+
+class DependencyPruner(LaserPlugin):
+    """See module docstring."""
+
+    def __init__(self):
+        self._reset()
+
+    def _reset(self):
+        self.iteration = 0
+        self.calls_on_path: Dict[int, bool] = {}
+        self.sloads_on_path: Dict[int, List[object]] = {}
+        self.sstores_on_path: Dict[int, List[object]] = {}
+        self.storage_accessed_global: Set = set()
+
+    def update_sloads(self, path: List[int], target_location) -> None:
+        for address in path:
+            entry = self.sloads_on_path.setdefault(address, [])
+            if target_location not in entry:
+                entry.append(target_location)
+
+    def update_sstores(self, path: List[int], target_location) -> None:
+        for address in path:
+            entry = self.sstores_on_path.setdefault(address, [])
+            if target_location not in entry:
+                entry.append(target_location)
+
+    def update_calls(self, path: List[int]) -> None:
+        for address in path:
+            if address in self.sstores_on_path:
+                self.calls_on_path[address] = True
+
+    def wanna_execute(self, address: int,
+                      annotation: DependencyAnnotation) -> bool:
+        """Should the (previously seen) block at `address` run again?"""
+        storage_write_cache = annotation.get_storage_write_cache(
+            self.iteration - 1
+        )
+        if address in self.calls_on_path:
+            return True
+        # pure paths with no read dependencies can be skipped outright
+        if address not in self.sloads_on_path:
+            return False
+        if address in self.storage_accessed_global:
+            for location in self.sstores_on_path:
+                try:
+                    get_model((location == address,))
+                    return True
+                except UnsatError:
+                    continue
+        dependencies = self.sloads_on_path[address]
+        for location in storage_write_cache:
+            for dependency in dependencies:
+                try:
+                    get_model((location == dependency,))
+                    return True
+                except UnsatError:
+                    continue
+            for dependency in annotation.storage_loaded:
+                try:
+                    get_model((location == dependency,))
+                    return True
+                except UnsatError:
+                    continue
+        return False
+
+    def initialize(self, symbolic_vm) -> None:
+        self._reset()
+
+        @symbolic_vm.laser_hook("start_sym_trans")
+        def start_sym_trans_hook():
+            self.iteration += 1
+
+        def _check_basic_block(address: int,
+                               annotation: DependencyAnnotation):
+            if self.iteration < 2:
+                return
+            if address not in annotation.blocks_seen:
+                annotation.blocks_seen.add(address)
+                return
+            if self.wanna_execute(address, annotation):
+                return
+            log.debug(
+                "Skipping state: storage slots %s not read in block at "
+                "address %d",
+                annotation.get_storage_write_cache(self.iteration - 1),
+                address,
+            )
+            raise PluginSkipState
+
+        @symbolic_vm.post_hook("JUMP")
+        def jump_hook(state: GlobalState):
+            try:
+                address = state.get_current_instruction()["address"]
+            except IndexError:
+                raise PluginSkipState
+            annotation = get_dependency_annotation(state)
+            annotation.path.append(address)
+            _check_basic_block(address, annotation)
+
+        @symbolic_vm.post_hook("JUMPI")
+        def jumpi_hook(state: GlobalState):
+            try:
+                address = state.get_current_instruction()["address"]
+            except IndexError:
+                raise PluginSkipState
+            annotation = get_dependency_annotation(state)
+            annotation.path.append(address)
+            _check_basic_block(address, annotation)
+
+        @symbolic_vm.pre_hook("SSTORE")
+        def sstore_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            location = state.mstate.stack[-1]
+            self.update_sstores(annotation.path, location)
+            annotation.extend_storage_write_cache(
+                self.iteration, location
+            )
+
+        @symbolic_vm.pre_hook("SLOAD")
+        def sload_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            location = state.mstate.stack[-1]
+            if location not in annotation.storage_loaded:
+                annotation.storage_loaded.add(location)
+            # backwards-annotate: execution may never reach STOP/RETURN
+            self.update_sloads(annotation.path, location)
+            self.storage_accessed_global.add(location)
+
+        @symbolic_vm.pre_hook("CALL")
+        def call_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            self.update_calls(annotation.path)
+            annotation.has_call = True
+
+        @symbolic_vm.pre_hook("STATICCALL")
+        def staticcall_hook(state: GlobalState):
+            annotation = get_dependency_annotation(state)
+            self.update_calls(annotation.path)
+            annotation.has_call = True
+
+        def _transaction_end(state: GlobalState) -> None:
+            annotation = get_dependency_annotation(state)
+            for index in annotation.storage_loaded:
+                self.update_sloads(annotation.path, index)
+            for index in annotation.storage_written:
+                self.update_sstores(annotation.path, index)
+            if annotation.has_call:
+                self.update_calls(annotation.path)
+
+        @symbolic_vm.pre_hook("STOP")
+        def stop_hook(state: GlobalState):
+            _transaction_end(state)
+
+        @symbolic_vm.pre_hook("RETURN")
+        def return_hook(state: GlobalState):
+            _transaction_end(state)
+
+        @symbolic_vm.laser_hook("add_world_state")
+        def world_state_filter_hook(state: GlobalState):
+            if isinstance(
+                state.current_transaction, ContractCreationTransaction
+            ):
+                self.iteration = 0
+                return
+            world_state_annotation = get_ws_dependency_annotation(state)
+            annotation = get_dependency_annotation(state)
+            # keep storage_written across transactions; reset the rest
+            annotation.path = [0]
+            annotation.storage_loaded = set()
+            world_state_annotation.annotations_stack.append(annotation)

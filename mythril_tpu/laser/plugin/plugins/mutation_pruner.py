@@ -1,0 +1,70 @@
+"""Mutation pruner plugin (capability parity:
+mythril/laser/plugin/plugins/mutation_pruner.py:22-89): world states whose
+transaction made no mutation and provably transferred no value are not
+re-queued — kills clean-path explosion."""
+
+from ....exceptions import UnsatError
+from ....smt import UGT, symbol_factory
+from ....support.model import get_model
+from ...state.global_state import GlobalState
+from ...transaction.transaction_models import ContractCreationTransaction
+from ..builder import PluginBuilder
+from ..interface import LaserPlugin
+from ..signals import PluginSkipWorldState
+from .plugin_annotations import MutationAnnotation
+
+
+class MutationPrunerBuilder(PluginBuilder):
+    name = "mutation-pruner"
+
+    def __call__(self, *args, **kwargs):
+        return MutationPruner()
+
+
+class MutationPruner(LaserPlugin):
+    """Hooks mutating instructions to annotate states; filters un-mutated
+    end states at add_world_state."""
+
+    def initialize(self, symbolic_vm):
+        @symbolic_vm.pre_hook("SSTORE")
+        def sstore_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.pre_hook("CALL")
+        def call_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.pre_hook("STATICCALL")
+        def staticcall_mutator_hook(global_state: GlobalState):
+            global_state.annotate(MutationAnnotation())
+
+        @symbolic_vm.laser_hook("add_world_state")
+        def world_state_filter_hook(global_state: GlobalState):
+            if isinstance(
+                global_state.current_transaction,
+                ContractCreationTransaction,
+            ):
+                return
+            if isinstance(global_state.environment.callvalue, int):
+                callvalue = symbol_factory.BitVecVal(
+                    global_state.environment.callvalue, 256
+                )
+            else:
+                callvalue = global_state.environment.callvalue
+            try:
+                constraints = global_state.world_state.constraints + [
+                    UGT(callvalue, symbol_factory.BitVecVal(0, 256))
+                ]
+                get_model(constraints)
+                return  # balance mutation possible
+            except UnsatError:
+                pass
+            if (
+                len(
+                    list(
+                        global_state.get_annotations(MutationAnnotation)
+                    )
+                )
+                == 0
+            ):
+                raise PluginSkipWorldState

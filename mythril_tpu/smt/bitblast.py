@@ -235,7 +235,14 @@ class Blaster:
         elif op == T.BOOL_VAR:
             v = self.new_lit()
         elif op == T.EQ:
-            v = self.eq_vec(self.bits(t.args[0]), self.bits(t.args[1]))
+            if t.args[0].is_bool:
+                v = -self.g_xor(
+                    self.bool_lit(t.args[0]), self.bool_lit(t.args[1])
+                )
+            else:
+                v = self.eq_vec(
+                    self.bits(t.args[0]), self.bits(t.args[1])
+                )
         elif op == T.ULT:
             v = self.ult_vec(self.bits(t.args[0]), self.bits(t.args[1]))
         elif op == T.ULE:

@@ -29,6 +29,10 @@ class Expression(Generic[G]):
     def annotations(self) -> Set:
         return self._annotations
 
+    @annotations.setter
+    def annotations(self, value) -> None:
+        self._annotations = set(value)
+
     def annotate(self, annotation) -> None:
         self._annotations.add(annotation)
 

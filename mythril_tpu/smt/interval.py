@@ -174,7 +174,8 @@ def _interval_node(t: "T.Term", memo):
         v = full
     elif op == T.EQ:
         a, b = t.args
-        if a.is_array or b.is_array:
+        if a.is_array or b.is_array or a.is_bool or b.is_bool:
+            # array/bool equalities carry no numeric interval information
             v = (True, True)
         else:
             (alo, ahi) = interval(a, memo)

@@ -164,7 +164,14 @@ class IndependenceSolver:
         self.models = []
         overall = sat
         for ts in merged.values():
-            ctx = check(ts, timeout_s=self.timeout_ms / 1000.0)
+            try:
+                ctx = check(ts, timeout_s=self.timeout_ms / 1000.0)
+            except Exception as e:  # parity with BaseSolver: crash -> unknown
+                log.info(
+                    "solver exception treated as unknown: %r", e
+                )
+                overall = unknown
+                continue
             if ctx.status == unsat:
                 return unsat
             if ctx.status == unknown:

@@ -304,6 +304,7 @@ def _optimize_objectives(blaster, sat, minimize, maximize, subs, timeout_s,
         if obj_sub.op == T.BV_CONST:
             continue
         try:
+            blaster._ensure_blasted(obj_sub)  # deep terms: avoid recursion
             bits = blaster.bits(obj_sub)
         except NotImplementedError:
             continue  # objective contains arrays not present in constraints
@@ -396,10 +397,17 @@ def _extract_model(blaster, sat, subs, select_map, apply_map) -> ModelData:
 
 
 _TID_INDEX: Dict[int, "T.Term"] = {}
+_TID_INDEXED_UPTO = [0]
 
 
 def _term_by_tid(tid: int) -> Optional["T.Term"]:
+    # _table is insertion-ordered and append-only: index only the suffix
+    # of terms created since the last call (amortized O(new terms))
     if len(_TID_INDEX) != T.dag_size():
-        for t in T._table.values():
+        import itertools
+
+        skip = _TID_INDEXED_UPTO[0]
+        for t in itertools.islice(T._table.values(), skip, None):
             _TID_INDEX[t.tid] = t
+        _TID_INDEXED_UPTO[0] = T.dag_size()
     return _TID_INDEX.get(tid)

@@ -1,0 +1,28 @@
+"""EVM gas/protocol constants (role of the py-evm constants the reference
+imports — reference machine_state.py:8-10, instruction_data.py:4-14; values
+are EVM yellow-paper/EIP constants)."""
+
+GAS_MEMORY = 3
+GAS_MEMORY_QUADRATIC_DENOMINATOR = 512
+
+GAS_SHA3 = 30
+GAS_SHA3WORD = 6
+
+GAS_ECRECOVER = 3000
+GAS_SHA256 = 60
+GAS_SHA256WORD = 12
+GAS_RIPEMD160 = 600
+GAS_RIPEMD160WORD = 120
+GAS_IDENTITY = 15
+GAS_IDENTITYWORD = 3
+
+GAS_CALLSTIPEND = 2300
+GAS_CALLVALUE = 9000
+GAS_NEWACCOUNT = 25000
+
+STACK_LIMIT = 1024
+BLOCK_GAS_LIMIT = 8000000
+
+
+def ceil32(x: int) -> int:
+    return x if x % 32 == 0 else x + 32 - (x % 32)

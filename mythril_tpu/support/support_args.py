@@ -1,0 +1,35 @@
+"""Process-global analysis flag singleton (reference parity:
+mythril/support/support_args.py:5-26). Written once by MythrilAnalyzer,
+read across the engine."""
+
+from typing import List, Optional
+
+from .support_utils import Singleton
+
+
+class Args(object, metaclass=Singleton):
+    """Cross-module analysis flags."""
+
+    def __init__(self):
+        self.solver_log: Optional[str] = None
+        self.transaction_sequences: Optional[List[List]] = None
+        self.use_integer_module = True
+        self.use_issue_annotations = False
+        self.solver_timeout = 10000
+        self.parallel_solving = False
+        self.unconstrained_storage = False
+        self.call_depth_limit = 3
+        self.iprof = None
+        self.solc_args = None
+        self.disable_dependency_pruning = False
+        self.disable_coverage_strategy = False
+        self.disable_mutation_pruner = False
+        self.incremental_txs = True
+        self.epic = False
+        self.pruning_factor: Optional[float] = None
+        # TPU lane-engine knobs (new in this build)
+        self.tpu_lanes = 0  # 0 = host-only engine; >0 = batched lane engine
+        self.tpu_prefilter = True
+
+
+args = Args()

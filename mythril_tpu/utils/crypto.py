@@ -1,0 +1,205 @@
+"""Self-contained crypto for the precompile set.
+
+Role parity with the wheels the reference links against (reference
+natives.py:5-12: coincurve/libsecp256k1, py_ecc bn128, blake2b-py): pure
+Python here — precompiles execute on the host for concrete inputs only
+(symbolic inputs degrade to fresh symbols at the call site), so these paths
+are rare and never hot.
+"""
+
+from typing import List, Optional, Tuple
+
+# --- secp256k1 --------------------------------------------------------------
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+Gx = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+Gy = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _ec_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def _ec_mul(point, scalar: int):
+    result = None
+    addend = point
+    while scalar:
+        if scalar & 1:
+            result = _ec_add(result, addend)
+        addend = _ec_add(addend, addend)
+        scalar >>= 1
+    return result
+
+
+def secp256k1_recover(msg_hash: bytes, v: int,
+                      r: int, s: int) -> Optional[Tuple[int, int]]:
+    """Recover the public key point from an ECDSA signature
+    (ecrecover precompile core)."""
+    if r >= N or s >= N or v < 27 or v > 28:
+        return None
+    recid = v - 27
+    x = r
+    alpha = (pow(x, 3, P) + 7) % P
+    beta = pow(alpha, (P + 1) // 4, P)
+    if beta * beta % P != alpha:
+        return None
+    y = beta if (beta & 1) == (recid & 1) else P - beta
+    e = int.from_bytes(msg_hash, "big")
+    R = (x, y)
+    rinv = _inv(r, N)
+    # Q = r^-1 (s*R - e*G)
+    sR = _ec_mul(R, s)
+    eG = _ec_mul((Gx, Gy), e % N)
+    neg_eG = None if eG is None else (eG[0], (-eG[1]) % P)
+    Q = _ec_mul(_ec_add(sR, neg_eG), rinv)
+    return Q
+
+
+# --- alt_bn128 (G1 only; pairing deferred to precompile fallback) ----------
+
+BN_P = (
+    21888242871839275222246405745257275088696311157297823662689037894645226208583
+)
+BN_N = (
+    21888242871839275222246405745257275088548364400416034343698204186575808495617
+)
+
+
+def _bn_inv(a: int) -> int:
+    return pow(a, -1, BN_P)
+
+
+def bn128_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - (x * x * x + 3)) % BN_P == 0
+
+
+def bn128_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % BN_P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1) * _bn_inv(2 * y1) % BN_P
+    else:
+        lam = (y2 - y1) * _bn_inv((x2 - x1) % BN_P) % BN_P
+    x3 = (lam * lam - x1 - x2) % BN_P
+    y3 = (lam * (x1 - x3) - y1) % BN_P
+    return (x3, y3)
+
+
+def bn128_mul(pt, scalar: int):
+    result = None
+    addend = pt
+    scalar %= BN_N
+    while scalar:
+        if scalar & 1:
+            result = bn128_add(result, addend)
+        addend = bn128_add(addend, addend)
+        scalar >>= 1
+    return result
+
+
+def bn128_decode_point(x: int, y: int):
+    """Validate and decode an affine point; (0,0) is infinity."""
+    if x == 0 and y == 0:
+        return None
+    if x >= BN_P or y >= BN_P:
+        raise ValueError("point coordinate out of field")
+    pt = (x, y)
+    if not bn128_is_on_curve(pt):
+        raise ValueError("point not on curve")
+    return pt
+
+
+def bn128_encode_point(pt) -> Tuple[int, int]:
+    if pt is None:
+        return (0, 0)
+    return pt
+
+
+# --- blake2b compression (EIP-152 F function) ------------------------------
+
+_B2B_IV = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1, 0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+_B2B_SIGMA = [
+    [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+    [14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3],
+    [11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4],
+    [7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8],
+    [9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13],
+    [2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9],
+    [12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11],
+    [13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10],
+    [6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5],
+    [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
+]
+
+_M64 = (1 << 64) - 1
+
+
+def _rotr64(x: int, n: int) -> int:
+    return ((x >> n) | (x << (64 - n))) & _M64
+
+
+def blake2b_compress(
+    rounds: int, h: List[int], m: List[int], t: Tuple[int, int], f: bool
+) -> List[int]:
+    """The blake2b F compression function (EIP-152 semantics)."""
+    v = h[:] + _B2B_IV[:]
+    v[12] ^= t[0] & _M64
+    v[13] ^= t[1] & _M64
+    if f:
+        v[14] ^= _M64
+
+    def g(a, b, c, d, x, y):
+        v[a] = (v[a] + v[b] + x) & _M64
+        v[d] = _rotr64(v[d] ^ v[a], 32)
+        v[c] = (v[c] + v[d]) & _M64
+        v[b] = _rotr64(v[b] ^ v[c], 24)
+        v[a] = (v[a] + v[b] + y) & _M64
+        v[d] = _rotr64(v[d] ^ v[a], 16)
+        v[c] = (v[c] + v[d]) & _M64
+        v[b] = _rotr64(v[b] ^ v[c], 63)
+
+    for r in range(rounds):
+        s = _B2B_SIGMA[r % 10]
+        g(0, 4, 8, 12, m[s[0]], m[s[1]])
+        g(1, 5, 9, 13, m[s[2]], m[s[3]])
+        g(2, 6, 10, 14, m[s[4]], m[s[5]])
+        g(3, 7, 11, 15, m[s[6]], m[s[7]])
+        g(0, 5, 10, 15, m[s[8]], m[s[9]])
+        g(1, 6, 11, 12, m[s[10]], m[s[11]])
+        g(2, 7, 8, 13, m[s[12]], m[s[13]])
+        g(3, 4, 9, 14, m[s[14]], m[s[15]])
+
+    return [h[i] ^ v[i] ^ v[i + 8] for i in range(8)]

@@ -1,0 +1,222 @@
+"""Concrete-execution conformance: replay hand-assembled programs through
+the engine and check storage post-state against an independent Python-int
+oracle.
+
+This is this build's analog of the reference's VMTests driver
+(tests/laser/evm_testsuite/evm_test.py): same shape (build world state, run
+a concrete message call, assert post-storage), with generated vectors
+instead of vendored fixtures — the oracle is Python arbitrary-precision
+arithmetic, fully independent of the engine's term/limb representations."""
+
+import random
+
+import pytest
+
+from mythril_tpu.laser.svm import LaserEVM
+from mythril_tpu.laser.state.world_state import WorldState
+from mythril_tpu.laser.transaction.concolic import execute_message_call
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+ADDR = 0x0901F2C0AB0C0A0101010101010101010101F2C1
+
+
+def asm(*parts) -> bytearray:
+    out = bytearray()
+    for p in parts:
+        if isinstance(p, str):
+            out.append(OPCODES[p][ADDRESS])
+        else:
+            out.extend(p)
+    return out
+
+
+def push32(v: int) -> bytearray:
+    return asm("PUSH32", v.to_bytes(32, "big"))
+
+
+def run_concrete(code: bytes, calldata=b""):
+    laser = LaserEVM(requires_statespace=False, execution_timeout=60)
+    world_state = WorldState()
+    account = world_state.create_account(
+        balance=10**18, address=ADDR, concrete_storage=True
+    )
+    from mythril_tpu.disassembler.disassembly import Disassembly
+
+    account.code = Disassembly(code.hex())
+    laser.open_states = [world_state]
+    final_states = execute_message_call(
+        laser,
+        callee_address=symbol_factory.BitVecVal(ADDR, 256),
+        caller_address=symbol_factory.BitVecVal(0xACE, 256),
+        origin_address=symbol_factory.BitVecVal(0xACE, 256),
+        code=code.hex(),
+        data=list(calldata),
+        gas_limit=8000000,
+        gas_price=10,
+        value=0,
+        track_gas=True,
+    )
+    return final_states
+
+
+def storage_value(final_states, slot: int) -> int:
+    assert final_states, "execution produced no final state"
+    account = final_states[0].world_state.accounts[ADDR]
+    val = account.storage[symbol_factory.BitVecVal(slot, 256)]
+    assert val.value is not None, f"storage[{slot}] not concrete: {val}"
+    return val.value
+
+
+M = 2**256
+BINOPS = {
+    "ADD": lambda a, b: (a + b) % M,
+    "SUB": lambda a, b: (a - b) % M,
+    "MUL": lambda a, b: (a * b) % M,
+    "DIV": lambda a, b: 0 if b == 0 else a // b,
+    "MOD": lambda a, b: 0 if b == 0 else a % b,
+    "AND": lambda a, b: a & b,
+    "OR": lambda a, b: a | b,
+    "XOR": lambda a, b: a ^ b,
+    "EXP": lambda a, b: pow(a, b, M),
+}
+
+
+def signed(v):
+    return v - M if v >> 255 else v
+
+
+SIGNED_BINOPS = {
+    "SDIV": lambda a, b: 0
+    if b == 0 or signed(b) == 0
+    else (
+        (abs(signed(a)) // abs(signed(b)))
+        * (-1 if (signed(a) < 0) != (signed(b) < 0) else 1)
+    )
+    % M,
+    "SMOD": lambda a, b: 0
+    if signed(b) == 0
+    else ((abs(signed(a)) % abs(signed(b))) * (-1 if signed(a) < 0 else 1))
+    % M,
+    "SLT": lambda a, b: int(signed(a) < signed(b)),
+    "SGT": lambda a, b: int(signed(a) > signed(b)),
+}
+CMP_BINOPS = {
+    "LT": lambda a, b: int(a < b),
+    "GT": lambda a, b: int(a > b),
+    "EQ": lambda a, b: int(a == b),
+}
+
+
+@pytest.mark.parametrize("op", sorted(BINOPS | SIGNED_BINOPS | CMP_BINOPS))
+def test_binop_conformance(op):
+    oracle = (BINOPS | SIGNED_BINOPS | CMP_BINOPS)[op]
+    random.seed(hash(op) & 0xFFFF)
+    cases = []
+    for _ in range(6):
+        bits_a = random.choice([8, 64, 255, 256])
+        bits_b = random.choice([8, 16, 256])
+        cases.append(
+            (random.getrandbits(bits_a), random.getrandbits(bits_b))
+        )
+    cases += [(0, 0), (M - 1, M - 1), (1, 0), (0, 1), (M - 1, 1)]
+    if op == "EXP":
+        cases = [(a % 2**16, b % 2**8) for a, b in cases]
+
+    prog = bytearray()
+    for slot, (a, b) in enumerate(cases):
+        # stack order: op pops top as first operand
+        prog += push32(b) + push32(a) + asm(op)
+        prog += push32(slot) + asm("SSTORE")
+    prog += asm("STOP")
+
+    finals = run_concrete(bytes(prog))
+    for slot, (a, b) in enumerate(cases):
+        expected = oracle(a, b)
+        got = storage_value(finals, slot)
+        assert got == expected, (
+            f"{op}({hex(a)}, {hex(b)}): got {hex(got)}, "
+            f"expected {hex(expected)}"
+        )
+
+
+def test_shifts_and_byte_conformance():
+    random.seed(99)
+    prog = bytearray()
+    expected = []
+    slot = 0
+    for _ in range(8):
+        v = random.getrandbits(256)
+        sh = random.choice([0, 1, 7, 8, 255, 256, 300])
+        for op, oracle in (
+            ("SHL", lambda v, s: (v << s) % M if s < 256 else 0),
+            ("SHR", lambda v, s: v >> s if s < 256 else 0),
+            ("SAR", lambda v, s: (signed(v) >> min(s, 255)) % M),
+        ):
+            prog += push32(v) + push32(sh) + asm(op)
+            prog += push32(slot) + asm("SSTORE")
+            expected.append((slot, oracle(v, sh)))
+            slot += 1
+    prog += asm("STOP")
+    finals = run_concrete(bytes(prog))
+    for s, e in expected:
+        assert storage_value(finals, s) == e, s
+
+
+def test_memory_mstore_mload_roundtrip():
+    random.seed(5)
+    v = random.getrandbits(256)
+    prog = (
+        push32(v)
+        + asm("PUSH1", b"\x40", "MSTORE")
+        + asm("PUSH1", b"\x40", "MLOAD")
+        + push32(0)
+        + asm("SSTORE", "STOP")
+    )
+    finals = run_concrete(bytes(prog))
+    assert storage_value(finals, 0) == v
+
+
+def test_calldata_and_sha3():
+    from mythril_tpu.support.support_utils import sha3
+
+    data = bytes(range(1, 33))
+    # store calldataload(0) then keccak256(mem[0:32])
+    prog = (
+        asm("PUSH1", b"\x00", "CALLDATALOAD")
+        + push32(0)
+        + asm("SSTORE")
+        + asm("PUSH1", b"\x00", "CALLDATALOAD", "PUSH1", b"\x00",
+              "MSTORE")
+        + asm("PUSH1", b"\x20", "PUSH1", b"\x00", "SHA3")
+        + push32(1)
+        + asm("SSTORE", "STOP")
+    )
+    finals = run_concrete(bytes(prog), calldata=data)
+    assert storage_value(finals, 0) == int.from_bytes(data, "big")
+    assert storage_value(finals, 1) == int.from_bytes(sha3(data), "big")
+
+
+def test_signextend_addmod_mulmod():
+    cases = [
+        ("SIGNEXTEND", 0, 0xFF, M - 1),
+        ("SIGNEXTEND", 0, 0x7F, 0x7F),
+        ("SIGNEXTEND", 1, 0x8000, (M - 2**15)),
+        ("SIGNEXTEND", 31, 5, 5),
+        ("SIGNEXTEND", 32, 5, 5),
+    ]
+    prog = bytearray()
+    for slot, (op, a, b, _) in enumerate(cases):
+        prog += push32(b) + push32(a) + asm(op)
+        prog += push32(slot) + asm("SSTORE")
+    # ADDMOD / MULMOD: (a+b)%n over 512-bit intermediate
+    prog += push32(7) + push32(M - 1) + push32(M - 2) + asm("ADDMOD")
+    prog += push32(100) + asm("SSTORE")
+    prog += push32(12) + push32(M - 1) + push32(M - 5) + asm("MULMOD")
+    prog += push32(101) + asm("SSTORE")
+    prog += asm("STOP")
+    finals = run_concrete(bytes(prog))
+    for slot, (_, _, _, expected) in enumerate(cases):
+        assert storage_value(finals, slot) == expected, slot
+    assert storage_value(finals, 100) == ((M - 2) + (M - 1)) % 7
+    assert storage_value(finals, 101) == ((M - 5) * (M - 1)) % 12

@@ -1,0 +1,163 @@
+"""End-to-end analysis accuracy tests on hand-assembled bytecode.
+
+Mirrors the reference's integration test intent
+(tests/integration_tests/analysis_tests.py: issue counts + exact exploit
+calldata) using this build's own assembler instead of compiled fixtures."""
+
+import pytest
+
+from mythril_tpu.analysis.security import fire_lasers
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+from mythril_tpu.support.support_utils import sha3
+
+
+def asm(*parts) -> bytearray:
+    out = bytearray()
+    for p in parts:
+        if isinstance(p, str):
+            out.append(OPCODES[p][ADDRESS])
+        else:
+            out.extend(p)
+    return out
+
+
+def selector(sig: str) -> bytes:
+    return sha3(sig.encode())[:4]
+
+
+def dispatcher(entries, body):
+    """Build `selector -> JUMPDEST` dispatch prologue + body blocks.
+
+    entries: list of (sig, body_offset_key); body: dict key -> bytearray
+    (each block must start with JUMPDEST)."""
+    prog = asm("PUSH1", b"\x00", "CALLDATALOAD", "PUSH1", b"\xe0", "SHR")
+    patch = []
+    for sig, key in entries:
+        prog += asm("DUP1", "PUSH4", selector(sig), "EQ", "PUSH2",
+                    b"\x00\x00", "JUMPI")
+        patch.append((len(prog) - 3, key))
+    prog += asm("STOP")
+    offsets = {}
+    for key, block in body.items():
+        offsets[key] = len(prog)
+        prog += block
+    for pos, key in patch:
+        prog[pos : pos + 2] = offsets[key].to_bytes(2, "big")
+    return prog
+
+
+def analyze(runtime_hex: str, modules, tx_count=1, name="test"):
+    contract = EVMContract(code=runtime_hex, name=name)
+    sym = SymExecWrapper(
+        contract,
+        address=0xDEADBEEF,
+        strategy="bfs",
+        max_depth=60,
+        execution_timeout=60,
+        create_timeout=10,
+        transaction_count=tx_count,
+        modules=modules,
+        compulsory_statespace=False,
+    )
+    return fire_lasers(sym, modules)
+
+
+def test_unprotected_selfdestruct_with_exploit():
+    prog = dispatcher(
+        [("kill()", "kill")],
+        {"kill": asm("JUMPDEST", "CALLER", "SELFDESTRUCT")},
+    )
+    issues = analyze(prog.hex(), ["AccidentallyKillable"])
+    assert len(issues) == 1
+    issue = issues[0]
+    assert issue.swc_id == "106"
+    assert issue.function == "kill()"
+    steps = issue.transaction_sequence["steps"]
+    assert steps[-1]["calldata"] == "0x" + selector("kill()").hex()
+
+
+def test_protected_selfdestruct_not_reported():
+    # owner-gated on a fixed address outside the ACTORS set: the caller is
+    # constrained to {CREATOR, ATTACKER, SOMEGUY}, so the guard is
+    # infeasible and no issue may be reported. (A storage-loaded owner
+    # WOULD be reported under runtime-only analysis — storage is
+    # unconstrained there, matching the reference's behavior.)
+    guard = asm(
+        "JUMPDEST",
+        "PUSH20", bytes.fromhex("cc" * 20),  # hardcoded owner
+        "CALLER", "EQ",
+        "PUSH2", b"\x00\x00", "JUMPI",  # patched below
+        "STOP",
+    )
+    prog = dispatcher([("kill()", "kill")], {"kill": guard})
+    # append the actual kill block; patch the inner JUMPI target
+    inner = len(prog)
+    prog += asm("JUMPDEST", "CALLER", "SELFDESTRUCT")
+    idx = bytes(prog).find(b"\x61\x00\x00\x57", 10)  # PUSH2 0000 JUMPI
+    prog[idx + 1 : idx + 3] = inner.to_bytes(2, "big")
+    issues = analyze(prog.hex(), ["AccidentallyKillable"])
+    assert len(issues) == 0
+
+
+def test_exception_state_reachable():
+    # INVALID reachable behind a selector
+    prog = dispatcher(
+        [("boom()", "boom")],
+        {"boom": asm("JUMPDEST", "INVALID")},
+    )
+    issues = analyze(prog.hex(), ["Exceptions"])
+    assert len(issues) == 1
+    assert issues[0].swc_id == "110"
+
+
+def test_ether_thief_on_open_withdraw():
+    # withdraw(): sends the whole balance to the caller
+    withdraw = asm(
+        "JUMPDEST",
+        "PUSH1", b"\x00", "PUSH1", b"\x00", "PUSH1", b"\x00",
+        "PUSH1", b"\x00",
+        "ADDRESS", "BALANCE",      # value = this.balance
+        "CALLER",                   # to
+        "PUSH2", b"\xff\xff",      # gas
+        "CALL",
+        "POP", "STOP",
+    )
+    prog = dispatcher([("withdraw()", "w")], {"w": withdraw})
+    issues = analyze(prog.hex(), ["EtherThief"], tx_count=1)
+    assert len(issues) == 1
+    assert issues[0].swc_id == "105"
+
+
+def test_origin_dependence():
+    # if (tx.origin == caller-ish const) { ... }
+    body = asm(
+        "JUMPDEST", "ORIGIN",
+        "PUSH20", bytes.fromhex("aa" * 20), "EQ",
+        "PUSH2", b"\x00\x00", "JUMPI", "STOP",
+    )
+    prog = dispatcher([("auth()", "a")], {"a": body})
+    dest = len(prog)
+    prog += asm("JUMPDEST", "STOP")
+    idx = bytes(prog).rfind(b"\x61\x00\x00\x57")
+    prog[idx + 1 : idx + 3] = dest.to_bytes(2, "big")
+    issues = analyze(prog.hex(), ["TxOrigin"])
+    assert len(issues) == 1
+    assert issues[0].swc_id == "115"
+
+
+def test_integer_overflow_add():
+    # store(x): sstore(0, calldataload(4) + 2^255 ... ) overflowable add
+    body = asm(
+        "JUMPDEST",
+        "PUSH1", b"\x04", "CALLDATALOAD",
+        "PUSH32", b"\xff" * 32,
+        "ADD",
+        "PUSH1", b"\x00", "SSTORE",
+        "STOP",
+    )
+    prog = dispatcher([("store(uint256)", "s")], {"s": body})
+    issues = analyze(prog.hex(), ["IntegerArithmetics"])
+    assert len(issues) >= 1
+    assert issues[0].swc_id == "101"

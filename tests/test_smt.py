@@ -227,3 +227,34 @@ def test_if_mixed_bool_bitvec():
     assert r.size() == 256
     r2 = If(x == 1, 1, sf.BitVecVal(0, 256))
     assert r2.size() == 256
+
+
+def test_minimize_deep_objective_no_recursion_error():
+    x = sf.BitVecSym("mdo_x", 256)
+    t = x
+    for i in range(3000):
+        t = (t ^ (i | 1)) + 1
+    s = Optimize()
+    s.set_timeout(30000)
+    s.add(ULT(x, sf.BitVecVal(100, 256)))
+    s.minimize(t)
+    assert s.check() == sat
+
+
+def test_independence_solver_survives_unloweable_terms():
+    a1 = T.array_var("iso_a1", 256, 256)
+    a2 = T.array_var("iso_a2", 256, 256)
+    from mythril_tpu.smt.bool import Bool as SBool
+    s = IndependenceSolver()
+    s.add(SBool(T.mk_eq(a1, a2)))
+    r = s.check()  # must not raise; unknown acceptable
+    assert r in ("sat", "unsat", "unknown")
+
+
+def test_bool_equality_interval_not_spuriously_unsat():
+    from mythril_tpu.smt.bool import Bool as SBool
+    p = T.bool_var("beq_p")
+    q = T.bool_var("beq_q")
+    s = Solver()
+    s.add(SBool(T.mk_not(T.mk_eq(p, q))))
+    assert s.check() == sat
