@@ -518,10 +518,11 @@ class Instruction:
             )
             return [global_state]
 
-        try:
-            byte_list = [state.memory[index + i] for i in range(length_val)]
-        except TypeError:
-            # symbolic index
+        if index.symbolic:
+            # symbolic memory offset: the bytes hashed are unknowable, so
+            # hash a fresh per-site symbolic input (reference
+            # instructions.py:1027-1038) rather than reading memory's
+            # default-zero bytes at an unresolved address
             data = symbol_factory.BitVecSym(
                 f"sha3_input_{tx_id_manager.get_next_tx_id()}",
                 length_val * 8,
@@ -530,6 +531,7 @@ class Instruction:
             state.stack.append(result)
             return [global_state]
 
+        byte_list = [state.memory[index + i] for i in range(length_val)]
         if all(isinstance(b, int) for b in byte_list):
             data = symbol_factory.BitVecVal(
                 int.from_bytes(bytes(byte_list), "big"), length_val * 8
@@ -1431,13 +1433,7 @@ class Instruction:
 
     @StateTransition(increment_pc=False)
     def call_(self, global_state: GlobalState) -> List[GlobalState]:
-        instr = global_state.get_current_instruction()
         environment = global_state.environment
-
-        memory_out_size, memory_out_offset = (
-            global_state.mstate.stack[-7],
-            global_state.mstate.stack[-6],
-        )
         try:
             (
                 callee_address,
@@ -1520,7 +1516,6 @@ class Instruction:
 
     @StateTransition(increment_pc=False)
     def callcode_(self, global_state: GlobalState) -> List[GlobalState]:
-        instr = global_state.get_current_instruction()
         environment = global_state.environment
         try:
             (

@@ -33,19 +33,24 @@ def _cycle_count(trace: List[int]) -> int:
     trace. The trailing cycle is located by searching backwards for the
     most recent re-occurrence of the last two entries."""
     n = len(trace)
+    if n < 4:
+        return 0
     start = -1
-    for i in range(n - 3, 0, -1):
+    for i in range(n - 3, -1, -1):
         if trace[i] == trace[n - 2] and trace[i + 1] == trace[n - 1]:
             start = i
             break
     if start < 0:
         return 0
-    size = n - start - 2
+    size = (n - 2) - start
     if size <= 0:
         return 0
-    cycle = trace[start + 1 : start + 1 + size]
+    # count repetitions of the *trailing* window (the found window itself
+    # counts as one — matches reference get_loop_count,
+    # strategy/extensions/bounded_loops.py:102-145)
+    cycle = trace[n - size : n]
     count = 1
-    i = start + 1 - size
+    i = n - 2 * size
     while i >= 0 and trace[i : i + size] == cycle:
         count += 1
         i -= size

@@ -1,0 +1,538 @@
+"""256-bit bitvector arithmetic as JAX kernels over uint32 limb vectors.
+
+This is the device-side value representation of the batched LASER engine
+(SURVEY.md §2.10, path-level row; reference semantics:
+mythril/laser/ethereum/instructions.py:269-765 — ADD/MUL/SUB/DIV/SDIV/MOD/
+SMOD/ADDMOD/MULMOD/EXP/SIGNEXTEND, comparison and bitwise families, SHL/SHR/
+SAR/BYTE). A 256-bit EVM word is a vector of 8 little-endian uint32 limbs;
+a batch of N lanes is an (N, 8) uint32 array. Every function here is pure,
+jit-able, and broadcasts over arbitrary leading batch dimensions, so the
+same code path serves vmap'd single-op kernels, the fused `lax.switch`
+stepper (ops/stepper.py), and shard_map'd multi-chip lane batches
+(parallel/mesh.py).
+
+Design notes (TPU-first, not a port):
+- uint32 limbs, not uint64: XLA:TPU has no native 64-bit integer ALU; u32
+  adds/compares map directly onto VPU lanes.
+- multiplication decomposes into 16-bit digits so partial products fit in
+  uint32 without overflow; column sums of lo/hi halves stay < 2^21.
+- division is restoring shift-subtract over 256 steps via lax.fori_loop
+  (compiler-friendly static trip count; no data-dependent Python control
+  flow). EVM semantics: x/0 == 0, x%0 == 0.
+- variable shifts use limb-gather + bit-shift pairs, fully vectorized over
+  per-lane shift amounts.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NLIMBS = 8  # 8 x 32 = 256 bits
+NDIGITS = 16  # 16 x 16 = 256 bits (multiplication digits)
+U32 = jnp.uint32
+MASK16 = jnp.uint32(0xFFFF)
+WORD_BITS = 256
+
+
+# ---------------------------------------------------------------------------
+# host <-> device conversions (not jitted; used at batch build/extract time)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(value: int) -> np.ndarray:
+    """Python int -> (8,) little-endian uint32 limb array."""
+    value &= (1 << 256) - 1
+    return np.array(
+        [(value >> (32 * i)) & 0xFFFFFFFF for i in range(NLIMBS)],
+        dtype=np.uint32,
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    """(..., 8) limb array -> Python int (only for scalar/1-D input)."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    out = 0
+    for i in range(NLIMBS):
+        out |= int(arr[..., i]) << (32 * i)
+    return out
+
+
+def ints_to_batch(values) -> np.ndarray:
+    """List of Python ints -> (N, 8) uint32 batch."""
+    return np.stack([int_to_limbs(v) for v in values], axis=0)
+
+
+def batch_to_ints(batch) -> list:
+    arr = np.asarray(batch)
+    return [limbs_to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# constants
+# ---------------------------------------------------------------------------
+
+def zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros(tuple(shape) + (NLIMBS,), dtype=U32)
+
+
+def ones_mask(shape=()) -> jnp.ndarray:
+    return jnp.full(tuple(shape) + (NLIMBS,), 0xFFFFFFFF, dtype=U32)
+
+
+def from_u32(x) -> jnp.ndarray:
+    """Scalar/batched uint32 -> 256-bit words (value in limb 0)."""
+    x = jnp.asarray(x, dtype=U32)
+    return jnp.concatenate(
+        [x[..., None], jnp.zeros(x.shape + (NLIMBS - 1,), dtype=U32)], axis=-1
+    )
+
+
+# ---------------------------------------------------------------------------
+# add / sub with carry chains
+# ---------------------------------------------------------------------------
+
+def add(a, b):
+    """(a + b) mod 2^256. Unrolled 8-limb carry chain on the VPU."""
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(NLIMBS):
+        s = a[..., i] + b[..., i]
+        c1 = (s < a[..., i]).astype(U32)
+        s2 = s + carry
+        c2 = (s2 < s).astype(U32)
+        out.append(s2)
+        carry = c1 | c2
+    return jnp.stack(out, axis=-1)
+
+
+def neg(a):
+    """Two's complement negation."""
+    return add(~a, from_u32(jnp.ones(a.shape[:-1], dtype=U32)))
+
+
+def sub(a, b):
+    """(a - b) mod 2^256 via borrow chain."""
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(NLIMBS):
+        d = a[..., i] - b[..., i]
+        b1 = (a[..., i] < b[..., i]).astype(U32)
+        d2 = d - borrow
+        b2 = (d < borrow).astype(U32)
+        out.append(d2)
+        borrow = b1 | b2
+    return jnp.stack(out, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+def is_zero(a):
+    """bool mask: a == 0."""
+    acc = a[..., 0]
+    for i in range(1, NLIMBS):
+        acc = acc | a[..., i]
+    return acc == 0
+
+
+def eq(a, b):
+    acc = a[..., 0] ^ b[..., 0]
+    for i in range(1, NLIMBS):
+        acc = acc | (a[..., i] ^ b[..., i])
+    return acc == 0
+
+
+def ult(a, b):
+    """Unsigned a < b (lexicographic from the most-significant limb)."""
+    lt = jnp.zeros(a.shape[:-1], dtype=bool)
+    done = jnp.zeros(a.shape[:-1], dtype=bool)
+    for i in range(NLIMBS - 1, -1, -1):
+        limb_lt = a[..., i] < b[..., i]
+        limb_ne = a[..., i] != b[..., i]
+        lt = jnp.where(~done & limb_ne, limb_lt, lt)
+        done = done | limb_ne
+    return lt
+
+
+def ugt(a, b):
+    return ult(b, a)
+
+
+def sign_bit(a):
+    """bool mask: top bit set (negative in two's complement)."""
+    return (a[..., NLIMBS - 1] >> 31) != 0
+
+
+def slt(a, b):
+    sa, sb = sign_bit(a), sign_bit(b)
+    return jnp.where(sa == sb, ult(a, b), sa & ~sb)
+
+
+def sgt(a, b):
+    return slt(b, a)
+
+
+def bool_to_word(m):
+    """bool mask -> 256-bit 0/1 word (EVM comparison result)."""
+    return from_u32(m.astype(U32))
+
+
+# ---------------------------------------------------------------------------
+# bitwise
+# ---------------------------------------------------------------------------
+
+def bit_and(a, b):
+    return a & b
+
+
+def bit_or(a, b):
+    return a | b
+
+
+def bit_xor(a, b):
+    return a ^ b
+
+
+def bit_not(a):
+    return ~a
+
+
+# ---------------------------------------------------------------------------
+# multiplication (16-bit digit schoolbook, carry-save columns)
+# ---------------------------------------------------------------------------
+
+def _to_digits(a):
+    """(..., 8) u32 limbs -> (..., 16) u32 holding 16-bit digits."""
+    lo = a & MASK16
+    hi = a >> 16
+    return jnp.stack([lo, hi], axis=-1).reshape(a.shape[:-1] + (NDIGITS,))
+
+
+def _from_digits(d):
+    """(..., 16) 16-bit digits (already carry-propagated) -> (..., 8) limbs."""
+    d = d.reshape(d.shape[:-1] + (NLIMBS, 2))
+    return d[..., 0] | (d[..., 1] << 16)
+
+
+def _mul_digits(da, db, out_digits):
+    """Schoolbook product of 16-bit digit vectors.
+
+    Returns carry-propagated digit vector of length `out_digits`.
+    Column accumulation keeps lo/hi halves separate so sums stay < 2^21
+    (max 32 terms x (2^16 - 1)) — no uint32 overflow.
+    """
+    n = da.shape[-1]
+    cols_lo = [None] * (out_digits + 1)
+    cols_hi = [None] * (out_digits + 1)
+
+    def _acc(store, idx, val):
+        store[idx] = val if store[idx] is None else store[idx] + val
+
+    for i in range(n):
+        for j in range(n):
+            k = i + j
+            if k >= out_digits:
+                continue
+            prod = da[..., i] * db[..., j]
+            _acc(cols_lo, k, prod & MASK16)
+            _acc(cols_hi, k + 1, prod >> 16)
+
+    batch_shape = da.shape[:-1]
+    zero = jnp.zeros(batch_shape, dtype=U32)
+    out = []
+    carry = zero
+    for k in range(out_digits):
+        lo = cols_lo[k] if cols_lo[k] is not None else zero
+        hi = cols_hi[k] if cols_hi[k] is not None else zero
+        total = lo + hi + carry
+        out.append(total & MASK16)
+        carry = total >> 16
+    return jnp.stack(out, axis=-1)
+
+
+def mul(a, b):
+    """(a * b) mod 2^256."""
+    da, db = _to_digits(a), _to_digits(b)
+    digits = _mul_digits(da, db, NDIGITS)
+    return _from_digits(digits)
+
+
+def mul_full(a, b):
+    """Full 512-bit product as (lo, hi) 256-bit words."""
+    da, db = _to_digits(a), _to_digits(b)
+    digits = _mul_digits(da, db, 2 * NDIGITS)
+    lo = _from_digits(digits[..., :NDIGITS])
+    hi = _from_digits(digits[..., NDIGITS:])
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# shifts (variable, per-lane amounts)
+# ---------------------------------------------------------------------------
+
+def _shift_amounts(shift):
+    """shift: (...,) u32 low limb. Returns (limb_shift, bit_shift) with
+    out-of-range amounts clamped to 0 (callers mask via _word_shift_oob)."""
+    s = shift.astype(U32)
+    s = jnp.where(s >= WORD_BITS, 0, s)
+    return (s >> 5).astype(jnp.int32), (s & 31).astype(U32)
+
+
+def _gather_limb(a, idx):
+    """a: (..., 8); idx: (...,) int32 per-lane limb index (may be out of
+    [0,8) — clipped; caller masks). Returns (...,) u32 gathered limbs."""
+    idx_c = jnp.clip(idx, 0, NLIMBS - 1)
+    return jnp.take_along_axis(a, idx_c[..., None], axis=-1)[..., 0]
+
+
+def shl(a, shift):
+    """a << shift (shift is a 256-bit word; >=256 -> 0)."""
+    big = _word_shift_oob(shift)
+    ls, bs = _shift_amounts(shift[..., 0])
+    out = []
+    for i in range(NLIMBS):
+        src = i - ls  # source limb index
+        lo = jnp.where(src >= 0, _gather_limb(a, src), 0)
+        src2 = src - 1
+        lo2 = jnp.where(src2 >= 0, _gather_limb(a, src2), 0)
+        nb = (32 - bs) & 31
+        # bs == 0: plain limb move (avoid undefined >>32 via mask)
+        hi_part = jnp.where(bs == 0, 0, lo2 >> nb)
+        out.append((lo << bs) | hi_part)
+    res = jnp.stack(out, axis=-1)
+    return jnp.where(big[..., None], 0, res).astype(U32)
+
+
+def shr(a, shift):
+    """Logical a >> shift."""
+    big = _word_shift_oob(shift)
+    ls, bs = _shift_amounts(shift[..., 0])
+    out = []
+    for i in range(NLIMBS):
+        src = i + ls
+        lo = jnp.where(src < NLIMBS, _gather_limb(a, src), 0)
+        src2 = src + 1
+        hi = jnp.where(src2 < NLIMBS, _gather_limb(a, src2), 0)
+        nb = (32 - bs) & 31
+        hi_part = jnp.where(bs == 0, 0, hi << nb)
+        out.append((lo >> bs) | hi_part)
+    res = jnp.stack(out, axis=-1)
+    return jnp.where(big[..., None], 0, res).astype(U32)
+
+
+def sar(a, shift):
+    """Arithmetic a >> shift (sign-filling; >=256 -> 0 or -1).
+
+    Formulated as shr plus a sign-fill of the vacated top bits:
+    fill = ~(all_ones >> s); shr handles s >= 256 by returning 0, which
+    makes the fill all-ones — exactly the EVM's -1 result for negative a.
+    """
+    logical = shr(a, shift)
+    fill = ~shr(ones_mask(a.shape[:-1]), shift)
+    return jnp.where(sign_bit(a)[..., None], logical | fill, logical).astype(U32)
+
+
+MASK32 = jnp.uint32(0xFFFFFFFF)
+
+
+def _word_shift_oob(shift):
+    """True where a 256-bit shift-amount word is >= 256."""
+    high = shift[..., 0] >= WORD_BITS
+    rest = shift[..., 1]
+    for i in range(2, NLIMBS):
+        rest = rest | shift[..., i]
+    return high | (rest != 0)
+
+
+# ---------------------------------------------------------------------------
+# byte / signextend
+# ---------------------------------------------------------------------------
+
+def byte_op(pos, x):
+    """EVM BYTE: byte at big-endian position pos (0 = most significant)."""
+    oob = _word_shift_oob(pos) | (pos[..., 0] >= 32)
+    p = jnp.where(oob, 0, pos[..., 0]).astype(jnp.int32)
+    byte_index = 31 - p  # little-endian byte number
+    limb = byte_index >> 2
+    off = (byte_index & 3).astype(U32) * 8
+    val = (_gather_limb(x, limb) >> off) & 0xFF
+    return from_u32(jnp.where(oob, 0, val))
+
+
+def signextend(k, x):
+    """EVM SIGNEXTEND: sign-extend x from byte position k (0 = lowest)."""
+    oob = _word_shift_oob(k) | (k[..., 0] >= 31)
+    kk = jnp.where(oob, 31, k[..., 0]).astype(jnp.int32)
+    top_bit_index = kk * 8 + 7  # bit position of the sign bit
+    limb = top_bit_index >> 5
+    off = (top_bit_index & 31).astype(U32)
+    sign = (_gather_limb(x, limb) >> off) & 1
+    # build per-limb masks: bits above top_bit_index
+    limb_ids = jnp.arange(NLIMBS, dtype=jnp.int32)
+    shape = x.shape[:-1] + (NLIMBS,)
+    li = jnp.broadcast_to(limb_ids, shape)
+    lm = limb[..., None]
+    # mask of "keep" bits per limb
+    off_b = (off[..., None] + 1) & 31
+    full_keep = li < lm
+    partial = li == lm
+    none_keep = li > lm
+    partial_mask = jnp.where(
+        (off[..., None] == 31), MASK32, (jnp.uint32(1) << off_b) - 1
+    )
+    keep_mask = jnp.where(full_keep, MASK32, 0) | jnp.where(partial, partial_mask, 0)
+    keep_mask = jnp.where(none_keep, 0, keep_mask).astype(U32)
+    ext = jnp.where(sign[..., None] != 0, ~keep_mask, jnp.uint32(0))
+    res = (x & keep_mask) | ext
+    return jnp.where(oob[..., None], x, res).astype(U32)
+
+
+# ---------------------------------------------------------------------------
+# division / modulo (restoring shift-subtract)
+# ---------------------------------------------------------------------------
+
+def divmod_u(a, b):
+    """Unsigned (a // b, a % b); EVM: division by zero yields (0, 0)."""
+    bz = is_zero(b)
+
+    # limb/off are traced per-iteration from `i`; use dynamic gather
+    def body_dyn(i, carry):
+        quot, rem = carry
+        bit_index = (WORD_BITS - 1 - i).astype(jnp.int32)
+        limb = bit_index >> 5
+        off = (bit_index & 31).astype(U32)
+        abit = (_gather_limb(a, jnp.broadcast_to(limb, a.shape[:-1])) >> off) & 1
+        # the shift can carry into bit 256 when rem's divisor is near 2^256;
+        # fold the shifted-out bit into the >= test (rem stays < 2b < 2^257,
+        # so sub mod 2^256 still yields the true remainder)
+        carry257 = (rem[..., NLIMBS - 1] >> 31) != 0
+        rem = shl_one(rem)
+        rem = jnp.concatenate(
+            [(rem[..., 0] | abit)[..., None], rem[..., 1:]], axis=-1
+        )
+        ge = carry257 | ~ult(rem, b)
+        rem = jnp.where(ge[..., None], sub(rem, b), rem)
+        inc = ge.astype(U32) << off
+        limb_onehot = (
+            jnp.arange(NLIMBS, dtype=jnp.int32) == limb
+        ).astype(U32)
+        quot = quot | (inc[..., None] * limb_onehot)
+        return quot, rem
+
+    quot0 = zeros(a.shape[:-1])
+    rem0 = zeros(a.shape[:-1])
+    quot, rem = lax.fori_loop(
+        jnp.int32(0), jnp.int32(WORD_BITS), body_dyn, (quot0, rem0)
+    )
+    zero = zeros(a.shape[:-1])
+    return (
+        jnp.where(bz[..., None], zero, quot).astype(U32),
+        jnp.where(bz[..., None], zero, rem).astype(U32),
+    )
+
+
+def shl_one(a):
+    """a << 1 (cheap special case used in division inner loop)."""
+    out = [a[..., 0] << 1]
+    for i in range(1, NLIMBS):
+        out.append((a[..., i] << 1) | (a[..., i - 1] >> 31))
+    return jnp.stack(out, axis=-1)
+
+
+def div(a, b):
+    return divmod_u(a, b)[0]
+
+
+def mod(a, b):
+    return divmod_u(a, b)[1]
+
+
+def sdiv(a, b):
+    """Signed division, truncating toward zero (EVM SDIV).
+
+    Special case: (-2^255) / (-1) = -2^255 falls out of the magnitude
+    computation mod 2^256 automatically."""
+    sa, sb = sign_bit(a), sign_bit(b)
+    aa = jnp.where(sa[..., None], neg(a), a)
+    ab = jnp.where(sb[..., None], neg(b), b)
+    q = div(aa, ab)
+    qneg = sa ^ sb
+    return jnp.where(qneg[..., None], neg(q), q).astype(U32)
+
+
+def smod(a, b):
+    """Signed modulo: result takes the sign of the dividend (EVM SMOD)."""
+    sa, sb = sign_bit(a), sign_bit(b)
+    aa = jnp.where(sa[..., None], neg(a), a)
+    ab = jnp.where(sb[..., None], neg(b), b)
+    r = mod(aa, ab)
+    return jnp.where(sa[..., None], neg(r), r).astype(U32)
+
+
+def _divmod_512_by_256(lo, hi, m):
+    """(hi·2^256 + lo) mod m for ADDMOD/MULMOD — 512-step shift-subtract."""
+    mz = is_zero(m)
+
+    def body(i, rem):
+        bit_index = (512 - 1 - i).astype(jnp.int32)
+        in_hi = bit_index >= WORD_BITS
+        bi = jnp.where(in_hi, bit_index - WORD_BITS, bit_index)
+        limb = bi >> 5
+        off = (bi & 31).astype(U32)
+        src = jnp.where(in_hi, 1, 0)
+        limb_hi = _gather_limb(hi, jnp.broadcast_to(limb, hi.shape[:-1]))
+        limb_lo = _gather_limb(lo, jnp.broadcast_to(limb, lo.shape[:-1]))
+        abit = (jnp.where(src == 1, limb_hi, limb_lo) >> off) & 1
+        carry257 = (rem[..., NLIMBS - 1] >> 31) != 0
+        rem = shl_one(rem)
+        rem = jnp.concatenate(
+            [(rem[..., 0] | abit)[..., None], rem[..., 1:]], axis=-1
+        )
+        ge = carry257 | ~ult(rem, m)
+        rem = jnp.where(ge[..., None], sub(rem, m), rem)
+        return rem
+
+    rem = lax.fori_loop(jnp.int32(0), jnp.int32(512), body, zeros(lo.shape[:-1]))
+    return jnp.where(mz[..., None], zeros(lo.shape[:-1]), rem).astype(U32)
+
+
+def addmod(a, b, m):
+    """(a + b) % m over the full 257-bit sum (EVM ADDMOD)."""
+    s = add(a, b)
+    # carry out of the 256-bit add:
+    carry = ult(s, a)
+    hi = from_u32(carry.astype(U32))
+    return _divmod_512_by_256(s, hi, m)
+
+
+def mulmod(a, b, m):
+    """(a * b) % m over the full 512-bit product (EVM MULMOD)."""
+    lo, hi = mul_full(a, b)
+    return _divmod_512_by_256(lo, hi, m)
+
+
+# ---------------------------------------------------------------------------
+# exponentiation
+# ---------------------------------------------------------------------------
+
+def exp(base, exponent):
+    """base ** exponent mod 2^256 — square-and-multiply, 256 fixed steps."""
+
+    def body(i, carry):
+        result, acc = carry
+        limb = i >> 5
+        off = (i & 31).astype(U32)
+        ebit = (
+            _gather_limb(exponent, jnp.broadcast_to(limb, exponent.shape[:-1]))
+            >> off
+        ) & 1
+        new_result = mul(result, acc)
+        result = jnp.where((ebit != 0)[..., None], new_result, result)
+        acc = mul(acc, acc)
+        return result, acc
+
+    one = from_u32(jnp.ones(base.shape[:-1], dtype=U32))
+    result, _ = lax.fori_loop(
+        jnp.int32(0), jnp.int32(WORD_BITS), body, (one, base)
+    )
+    return result.astype(U32)
