@@ -1,0 +1,479 @@
+"""Differential test: batched lane stepper vs a tiny Python EVM oracle.
+
+The oracle implements the stepper's device-supported opcode subset with
+plain big-int semantics (the reference's per-opcode handlers,
+mythril/laser/ethereum/instructions.py, serve as the semantic source).
+Programs cover ALU, stack shuffling, memory, storage, calldata, jumps,
+and terminal ops; every lane of a batch runs a different calldata, and
+final stack/storage/status/return-data must agree lane-for-lane.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import bv256, stepper
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+M = 1 << 256
+random.seed(99)
+
+OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+
+def asm(*parts) -> bytes:
+    out = bytearray()
+    for p in parts:
+        if isinstance(p, str):
+            out.append(OP[p])
+        elif isinstance(p, int):
+            out.append(p)
+        else:
+            out.extend(p)
+    return bytes(out)
+
+
+def push(v, n=None):
+    if n is None:
+        n = max(1, (v.bit_length() + 7) // 8)
+    return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+
+def sgn(x):
+    return x - M if x >> 255 else x
+
+
+class Oracle:
+    """Reference interpreter for the device-supported subset."""
+
+    def __init__(self, code, calldata=b"", storage=None, env=None):
+        self.code = code
+        self.calldata = calldata
+        self.storage = dict(storage or {})
+        self.env = env or {}
+        self.stack = []
+        self.memory = bytearray()
+        self.pc = 0
+        self.status = "running"
+        self.returndata = b""
+        self.jumpdests = self._find_jumpdests()
+
+    def _find_jumpdests(self):
+        dests, i = set(), 0
+        while i < len(self.code):
+            op = self.code[i]
+            if op == OP["JUMPDEST"]:
+                dests.add(i)
+            i += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+        return dests
+
+    def _mem_ensure(self, end):
+        if len(self.memory) < end:
+            pad = (end + 31) // 32 * 32
+            self.memory.extend(b"\x00" * (pad - len(self.memory)))
+
+    def run(self, max_steps=10000):
+        for _ in range(max_steps):
+            if self.status != "running":
+                return self
+            self.step()
+        return self
+
+    def step(self):
+        code, st = self.code, self.stack
+        if self.pc >= len(code):
+            self.status = "stopped"
+            return
+        op = code[self.pc]
+        next_pc = self.pc + 1
+
+        def pop():
+            return st.pop()
+
+        try:
+            if 0x60 <= op <= 0x7F:
+                n = op - 0x5F
+                st.append(int.from_bytes(code[self.pc + 1 : self.pc + 1 + n], "big"))
+                next_pc = self.pc + 1 + n
+            elif 0x80 <= op <= 0x8F:
+                st.append(st[-(op - 0x7F)])
+            elif 0x90 <= op <= 0x9F:
+                n = op - 0x8F
+                st[-1], st[-1 - n] = st[-1 - n], st[-1]
+            elif op == OP["STOP"]:
+                self.status = "stopped"
+                return
+            elif op == OP["ADD"]:
+                st.append((pop() + pop()) % M)
+            elif op == OP["MUL"]:
+                st.append((pop() * pop()) % M)
+            elif op == OP["SUB"]:
+                a, b = pop(), pop()
+                st.append((a - b) % M)
+            elif op == OP["DIV"]:
+                a, b = pop(), pop()
+                st.append(0 if b == 0 else a // b)
+            elif op == OP["SDIV"]:
+                a, b = sgn(pop()), sgn(pop())
+                st.append(0 if b == 0 else (abs(a) // abs(b) * (1 if (a < 0) == (b < 0) else -1)) % M)
+            elif op == OP["MOD"]:
+                a, b = pop(), pop()
+                st.append(0 if b == 0 else a % b)
+            elif op == OP["SMOD"]:
+                a, b = sgn(pop()), sgn(pop())
+                st.append(0 if b == 0 else ((-1 if a < 0 else 1) * (abs(a) % abs(b))) % M)
+            elif op == OP["ADDMOD"]:
+                a, b, m = pop(), pop(), pop()
+                st.append(0 if m == 0 else (a + b) % m)
+            elif op == OP["MULMOD"]:
+                a, b, m = pop(), pop(), pop()
+                st.append(0 if m == 0 else (a * b) % m)
+            elif op == OP["EXP"]:
+                a, e = pop(), pop()
+                st.append(pow(a, e, M))
+            elif op == OP["SIGNEXTEND"]:
+                k, x = pop(), pop()
+                if k >= 31:
+                    st.append(x)
+                else:
+                    bits = 8 * (k + 1)
+                    low = x % (1 << bits)
+                    st.append((low - (1 << bits)) % M if low >> (bits - 1) else low)
+            elif op == OP["LT"]:
+                a, b = pop(), pop()
+                st.append(int(a < b))
+            elif op == OP["GT"]:
+                a, b = pop(), pop()
+                st.append(int(a > b))
+            elif op == OP["SLT"]:
+                a, b = pop(), pop()
+                st.append(int(sgn(a) < sgn(b)))
+            elif op == OP["SGT"]:
+                a, b = pop(), pop()
+                st.append(int(sgn(a) > sgn(b)))
+            elif op == OP["EQ"]:
+                st.append(int(pop() == pop()))
+            elif op == OP["ISZERO"]:
+                st.append(int(pop() == 0))
+            elif op == OP["AND"]:
+                st.append(pop() & pop())
+            elif op == OP["OR"]:
+                st.append(pop() | pop())
+            elif op == OP["XOR"]:
+                st.append(pop() ^ pop())
+            elif op == OP["NOT"]:
+                st.append(~pop() % M)
+            elif op == OP["BYTE"]:
+                i, x = pop(), pop()
+                st.append(0 if i >= 32 else (x >> (8 * (31 - i))) & 0xFF)
+            elif op == OP["SHL"]:
+                s, x = pop(), pop()
+                st.append(0 if s >= 256 else (x << s) % M)
+            elif op == OP["SHR"]:
+                s, x = pop(), pop()
+                st.append(0 if s >= 256 else x >> s)
+            elif op == OP["SAR"]:
+                s, x = pop(), pop()
+                st.append((sgn(x) >> min(s, 511)) % M)
+            elif op == OP["POP"]:
+                pop()
+            elif op == OP["MLOAD"]:
+                o = pop()
+                self._mem_ensure(o + 32)
+                st.append(int.from_bytes(self.memory[o : o + 32], "big"))
+            elif op == OP["MSTORE"]:
+                o, v = pop(), pop()
+                self._mem_ensure(o + 32)
+                self.memory[o : o + 32] = v.to_bytes(32, "big")
+            elif op == OP["MSTORE8"]:
+                o, v = pop(), pop()
+                self._mem_ensure(o + 1)
+                self.memory[o] = v & 0xFF
+            elif op == OP["MSIZE"]:
+                st.append(len(self.memory))
+            elif op == OP["SLOAD"]:
+                st.append(self.storage.get(pop(), 0))
+            elif op == OP["SSTORE"]:
+                k, v = pop(), pop()
+                self.storage[k] = v
+            elif op == OP["JUMP"]:
+                d = pop()
+                if d not in self.jumpdests:
+                    self.status = "invalid"
+                    return
+                next_pc = d
+            elif op == OP["JUMPI"]:
+                d, cond = pop(), pop()
+                if cond:
+                    if d not in self.jumpdests:
+                        self.status = "invalid"
+                        return
+                    next_pc = d
+            elif op == OP["JUMPDEST"]:
+                pass
+            elif op == OP["PC"]:
+                st.append(self.pc)
+            elif op == OP["CALLDATALOAD"]:
+                o = pop()
+                data = self.calldata[o : o + 32] if o < len(self.calldata) else b""
+                st.append(int.from_bytes(data.ljust(32, b"\x00"), "big"))
+            elif op == OP["CALLDATASIZE"]:
+                st.append(len(self.calldata))
+            elif op == OP["CODESIZE"]:
+                st.append(len(code))
+            elif op in (OP["CALLER"], OP["ORIGIN"], OP["ADDRESS"],
+                        OP["CALLVALUE"], OP["TIMESTAMP"], OP["NUMBER"]):
+                st.append(self.env.get(op, 0))
+            elif op == OP["RETURN"]:
+                o, ln = pop(), pop()
+                self._mem_ensure(o + ln)
+                self.returndata = bytes(self.memory[o : o + ln])
+                self.status = "returned"
+                return
+            elif op == OP["REVERT"]:
+                o, ln = pop(), pop()
+                self._mem_ensure(o + ln)
+                self.returndata = bytes(self.memory[o : o + ln])
+                self.status = "reverted"
+                return
+            elif op == OP["INVALID"]:
+                self.status = "invalid"
+                return
+            else:
+                raise NotImplementedError(hex(op))
+        except IndexError:
+            self.status = "invalid"
+            return
+        self.pc = next_pc
+
+
+STATUS_MAP = {
+    "stopped": stepper.Status.STOPPED,
+    "returned": stepper.Status.RETURNED,
+    "reverted": stepper.Status.REVERTED,
+    "invalid": stepper.Status.INVALID,
+}
+
+
+def run_both(code: bytes, calldatas, storages=None, env=None, max_steps=512):
+    """Run `code` over N lanes (one per calldata) on device and oracle."""
+    n = len(calldatas)
+    storages = storages or [{}] * n
+    env = env or {}
+    cc = stepper.compile_code(code)
+    st = stepper.init_lanes(n)
+    for i, cd in enumerate(calldatas):
+        st = stepper.set_calldata(st, i, cd)
+        if storages[i]:
+            st = stepper.preload_storage(st, i, storages[i])
+    for name, val in env.items():
+        st = stepper.set_env_word(st, name, val)
+    final = stepper.run(cc, st, max_steps)
+
+    oracles = []
+    for i, cd in enumerate(calldatas):
+        env_by_op = {OP[k]: v for k, v in env.items()}
+        o = Oracle(code, cd, storages[i], env_by_op).run(max_steps)
+        oracles.append(o)
+    return final, oracles
+
+
+def assert_match(final, oracles, check_stack=True):
+    for i, o in enumerate(oracles):
+        dev_status = int(final.status[i])
+        exp_status = STATUS_MAP[o.status]
+        assert dev_status == exp_status, (
+            f"lane {i}: status {dev_status} != {exp_status} ({o.status}), "
+            f"pc={int(final.pc[i])}"
+        )
+        if check_stack and o.status == "stopped":
+            dev_stack = stepper.extract_stack(final, i)
+            assert dev_stack == [v % M for v in o.stack], (
+                f"lane {i}: stack {[hex(v) for v in dev_stack]} != "
+                f"{[hex(v % M) for v in o.stack]}"
+            )
+        if o.status in ("returned", "reverted"):
+            assert stepper.extract_return_data(final, i) == o.returndata, (
+                f"lane {i}: return data mismatch"
+            )
+        dev_storage = stepper.extract_storage(final, i)
+        oracle_storage = {k: v for k, v in o.storage.items() if True}
+        # device log includes preloaded slots; compare full maps
+        assert dev_storage == oracle_storage, (
+            f"lane {i}: storage {dev_storage} != {oracle_storage}"
+        )
+
+
+def test_alu_program():
+    # ((cd[0] + 7) * 3 - 1) / 2, plus signed/bitwise mix, left on stack
+    code = asm(
+        push(0), "CALLDATALOAD",
+        push(7), "ADD",
+        push(3), "MUL",
+        push(1), "SWAP1", "SUB",
+        push(2), "SWAP1", "DIV",
+        "DUP1", push(0xFF), "AND",
+        "DUP2", push(4), "SHL",
+        "XOR",
+    )
+    cds = [int.to_bytes(v, 32, "big") for v in
+           [0, 1, 5, 1 << 255, M - 1, M - 7, 12345678901234567890]]
+    final, oracles = run_both(code, cds)
+    assert_match(final, oracles)
+
+
+def test_expensive_ops():
+    code = asm(
+        push(0), "CALLDATALOAD",  # x
+        "DUP1", "DUP1", push(97), "SWAP1", "MOD",   # x % 97... keep mixing
+        "SWAP1", push(3), "EXP",                     # (x)**3
+        "ADD",
+        "DUP2", "DUP2", "ADDMOD",
+        "DUP3", "SWAP1", "DUP2", "MULMOD",
+        "SWAP2", "SDIV",
+        "SMOD",
+    )
+    cds = [int.to_bytes(v, 32, "big") for v in
+           [2, 96, 97, (1 << 255) + 3, M - 2, 0]]
+    final, oracles = run_both(code, cds)
+    for i, o in enumerate(oracles):
+        assert int(final.status[i]) == STATUS_MAP[o.status]
+        assert stepper.extract_stack(final, i) == [v % M for v in o.stack], i
+
+
+def test_branching_divergent_lanes():
+    # if cd[0] > 100: store 1 at slot 5 else store 2 at slot cd[0]; return
+    code = bytearray()
+    code += asm(push(0), "CALLDATALOAD", "DUP1", push(100), "SWAP1", "GT")
+    code += asm(push(0), "JUMPI")  # patched
+    jumpi_at = len(code) - 3
+    code += asm(push(2), "SWAP1", "SSTORE", "STOP")  # else: sstore(cd0, 2)
+    then = len(code)
+    code += asm("JUMPDEST", "POP", push(1), push(5), "SSTORE",
+                push(0), push(0), "RETURN")
+    code[jumpi_at + 1] = then
+    code = bytes(asm(*[b for b in [bytes(code)]]))
+    cds = [int.to_bytes(v, 32, "big") for v in [0, 7, 100, 101, 5000, M - 1]]
+    final, oracles = run_both(code, cds)
+    assert_match(final, oracles)
+
+
+def test_memory_roundtrip_and_return():
+    # mstore cd[0] at 0, mstore8 0xAB at 33, return memory[0:64]
+    code = asm(
+        push(0), "CALLDATALOAD", push(0), "MSTORE",
+        push(0xAB), push(33), "MSTORE8",
+        "MSIZE",  # -> 64
+        push(0), "MSTORE",  # overwrite word 0 with msize
+        push(64), push(0), "RETURN",
+    )
+    cds = [int.to_bytes(v, 32, "big") for v in [0, M - 1, 0xDEADBEEF]]
+    final, oracles = run_both(code, cds)
+    assert_match(final, oracles, check_stack=False)
+
+
+def test_storage_read_over_write():
+    slots = {3: 111, 9: 222}
+    # sload(3) + sload(9) -> sstore(3, sum); sload(3) again on stack; stop
+    code = asm(
+        push(3), "SLOAD", push(9), "SLOAD", "ADD",
+        push(3), "SSTORE",
+        push(3), "SLOAD",
+        push(9), "SLOAD",
+    )
+    final, oracles = run_both(
+        code, [b"", b""], storages=[slots, {}]
+    )
+    assert_match(final, oracles)
+
+
+def test_env_words():
+    code = asm("CALLER", "ORIGIN", "CALLVALUE", "TIMESTAMP", "NUMBER",
+               "CALLDATASIZE", "CODESIZE", "PC")
+    env = {"CALLER": 0xDEADBEEF, "ORIGIN": 0xAFFE, "CALLVALUE": 10**18,
+           "TIMESTAMP": 1_700_000_000, "NUMBER": 19_000_000}
+    final, oracles = run_both(code, [b"", b"xyz"], env=env)
+    assert_match(final, oracles)
+
+
+def test_error_lanes():
+    # lane behavior on bad jump / stack underflow / invalid / revert
+    bad_jump = asm(push(3), "JUMP")  # 3 is not a JUMPDEST
+    underflow = asm("ADD")
+    invalid = asm("INVALID")
+    revert = asm(push(0), "CALLDATALOAD", push(0), "MSTORE",
+                 push(32), push(0), "REVERT")
+    for code in (bad_jump, underflow, invalid, revert):
+        final, oracles = run_both(code, [int.to_bytes(7, 32, "big")])
+        assert_match(final, oracles, check_stack=False)
+
+
+def test_unsupported_parks_lane():
+    code = asm(push(0), push(0), "SHA3")  # SHA3 not on device fast path
+    cc = stepper.compile_code(code)
+    st = stepper.init_lanes(2)
+    final = stepper.run(cc, st, 100)
+    assert int(final.status[0]) == stepper.Status.NEEDS_HOST
+    # parked at the SHA3 pc, stack intact for host resume
+    assert int(final.pc[0]) == 4
+    assert int(final.sp[0]) == 2
+
+
+def test_loop_program():
+    # for i in range(cd0): acc += i; sstore(0, acc)
+    code = bytearray()
+    code += asm(push(0), "CALLDATALOAD")        # [n]
+    code += asm(push(0), push(0))               # [n, acc, i]
+    loop = len(code)
+    code += asm("JUMPDEST", "DUP1", "DUP4", "EQ")  # [n,acc,i, i==n]
+    code += asm(push(0), "JUMPI")               # patched -> done
+    exit_patch = len(code) - 3
+    code += asm("DUP1", "SWAP2", "ADD", "SWAP1")  # acc+=i
+    code += asm(push(1), "ADD")                 # i+=1
+    code += asm(push(loop), "JUMP")
+    done = len(code)
+    code += asm("JUMPDEST", "POP", push(0), "SSTORE", "POP")
+    code[exit_patch + 1] = done
+    code = bytes(code)
+    cds = [int.to_bytes(v, 32, "big") for v in [0, 1, 5, 23]]
+    final, oracles = run_both(code, cds, max_steps=400)
+    assert_match(final, oracles)
+
+
+def test_random_programs_straightline():
+    """Fuzz: random straight-line stack programs, many lanes at once."""
+    binops = ["ADD", "MUL", "SUB", "DIV", "SDIV", "MOD", "SMOD", "AND",
+              "OR", "XOR", "LT", "GT", "SLT", "SGT", "EQ", "SHL", "SHR",
+              "SAR", "BYTE", "SIGNEXTEND", "EXP"]
+    unops = ["ISZERO", "NOT"]
+    for trial in range(5):
+        prog = [push(0), "CALLDATALOAD", push(32), "CALLDATALOAD"]
+        depth = 2
+        for _ in range(40):
+            r = random.random()
+            if r < 0.45 and depth >= 2:
+                prog.append(random.choice(binops))
+                depth -= 1
+            elif r < 0.55 and depth >= 1:
+                prog.append(random.choice(unops))
+            elif r < 0.75:
+                prog.append(push(random.getrandbits(random.choice([8, 64, 256]))))
+                depth += 1
+            elif r < 0.85 and depth >= 2:
+                n = random.randint(1, min(2, depth - 1))
+                prog.append(f"SWAP{n}")
+            else:
+                n = random.randint(1, min(3, depth))
+                prog.append(f"DUP{n}")
+                depth += 1
+        code = asm(*prog)
+        cds = [
+            random.getrandbits(512).to_bytes(64, "big") for _ in range(8)
+        ]
+        final, oracles = run_both(code, cds, max_steps=128)
+        for i, o in enumerate(oracles):
+            assert int(final.status[i]) == STATUS_MAP[o.status], (trial, i)
+            assert stepper.extract_stack(final, i) == [v % M for v in o.stack], (
+                trial, i
+            )
