@@ -1,0 +1,165 @@
+"""Benchmark: batched-lane path throughput vs host one-path-at-a-time.
+
+Primary metric (BASELINE.json): paths explored/sec/chip.
+
+The reference (dellalibera/mythril) cannot execute in this image (its Z3
+and solc dependencies are absent), and it publishes no numbers
+(BASELINE.md), so the denominator is the closest measurable stand-in for
+its design point: this framework's own host engine — a faithful
+capability-parity implementation of the reference's single-threaded
+one-GlobalState-at-a-time interpreter loop (laser/svm.py) — exploring the
+same contract. The numerator is the TPU lane engine executing a batch of
+concrete paths through the same bytecode on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_contract():
+    """Dispatcher + arithmetic loop: selector-gated work(x) that iterates
+    x % 97 times doing mul/add chains, then stores the result."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    code = bytearray()
+    code += push(0) + bytes([op["CALLDATALOAD"]])            # [x]
+    code += push(97) + bytes([op["SWAP1"], op["MOD"]])       # [x%97]
+    code += push(1)                                          # [n, acc]
+    loop = len(code)
+    code += bytes([op["JUMPDEST"], op["DUP2"], op["ISZERO"]])
+    code += push(0, 2) + bytes([op["JUMPI"]])
+    patch = len(code) - 3
+    # acc = acc*3 + n; n -= 1
+    code += push(3) + bytes([op["MUL"], op["DUP2"], op["ADD"]])
+    code += bytes([op["SWAP1"]]) + push(1) + bytes([op["SWAP1"], op["SUB"], op["SWAP1"]])
+    code += push(loop) + bytes([op["JUMP"]])
+    done = len(code)
+    code += bytes([op["JUMPDEST"]]) + push(0) + bytes([op["SSTORE"], op["STOP"]])
+    code[patch + 1 : patch + 3] = done.to_bytes(2, "big")
+    return bytes(code)
+
+
+def bench_device(code, n_lanes=4096, repeats=3):
+    """Lane engine: concrete path batch to completion on one chip."""
+    import jax
+
+    from mythril_tpu.ops import stepper
+
+    cc = stepper.compile_code(code)
+
+    def make_batch():
+        st = stepper.init_lanes(
+            n_lanes, stack_depth=16, memory_bytes=64, storage_slots=4,
+            calldata_bytes=32,
+        )
+        cd = np.zeros((n_lanes, 32), dtype=np.uint8)
+        for i in range(n_lanes):
+            cd[i] = np.frombuffer(
+                int.to_bytes(i * 2654435761 % (1 << 256), 32, "big"),
+                dtype=np.uint8,
+            )
+        return st._replace(
+            calldata=stepper.jnp.asarray(cd),
+            cd_size=stepper.jnp.full((n_lanes,), 32, stepper.jnp.int32),
+        )
+
+    max_steps = 700  # 97 iterations x ~6 instrs + prologue, with margin
+    run = jax.jit(stepper.run, static_argnums=(2,))
+
+    # warm-up / compile
+    out = run(cc, make_batch(), max_steps)
+    jax.block_until_ready(out.pc)
+    assert int((out.status == stepper.Status.RUNNING).sum()) == 0
+
+    best = float("inf")
+    total_instr = int(out.steps.sum())
+    for _ in range(repeats):
+        st = make_batch()
+        jax.block_until_ready(st.pc)
+        t0 = time.perf_counter()
+        out = run(cc, st, max_steps)
+        jax.block_until_ready(out.pc)
+        best = min(best, time.perf_counter() - t0)
+    return n_lanes / best, total_instr / best
+
+
+def bench_host(code):
+    """Host engine: symbolic exploration, one path at a time (the
+    reference's design point), measured as paths/sec."""
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    contract = EVMContract(code=code.hex(), name="bench")
+    t0 = time.perf_counter()
+    sym = SymExecWrapper(
+        contract,
+        address=0xDEADBEEF,
+        strategy="bfs",
+        max_depth=4096,
+        execution_timeout=25,
+        create_timeout=10,
+        transaction_count=1,
+        compulsory_statespace=False,
+    )
+    elapsed = time.perf_counter() - t0
+    # total_states = explored GlobalStates; a "path" in the lane metric is
+    # a full execution trace, so normalize by average trace length
+    states = max(sym.laser.total_states, 1)
+    avg_len = max(states / max(len(sym.laser.open_states), 1), 1.0)
+    return states / elapsed, states, elapsed, avg_len
+
+
+def _enable_compile_cache():
+    """Persist XLA compilations across bench runs: the lane-stepper graph
+    is large and the axon tunnel makes first compiles expensive."""
+    import os
+
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the persistent cache: run uncached
+
+
+def main():
+    _enable_compile_cache()
+    code = build_contract()
+
+    host_states_per_s, states, host_elapsed, avg_len = bench_host(code)
+    # host paths/sec: states-per-second over the mean path length
+    host_paths_per_s = host_states_per_s / avg_len
+
+    dev_paths_per_s, dev_instr_per_s = bench_device(code)
+
+    result = {
+        "metric": "paths explored/sec/chip",
+        "value": round(dev_paths_per_s, 1),
+        "unit": "paths/s",
+        "vs_baseline": round(dev_paths_per_s / max(host_paths_per_s, 1e-9), 1),
+        "detail": {
+            "device_lane_instr_per_s": round(dev_instr_per_s, 1),
+            "host_engine_states_per_s": round(host_states_per_s, 1),
+            "host_engine_states": states,
+            "host_engine_elapsed_s": round(host_elapsed, 2),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

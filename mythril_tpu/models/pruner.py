@@ -2,30 +2,50 @@
 
 This is the engine-facing seam of the TPU lane pruner (SURVEY.md §2.10,
 solver-level row): before per-state solver queries, all open states'
-constraint systems are screened with the interval domain. Host execution is
-the fallback; when the lane engine is active (support_args.args.tpu_lanes),
-the same transfer functions run vectorized on device over the whole batch
-(mythril_tpu/ops/intervals.py)."""
+constraint systems are screened with the interval domain. Small batches use
+the host transfer functions (mythril_tpu/smt/interval.py); larger batches
+are linearized and evaluated vectorized on device
+(mythril_tpu/ops/intervals.py), controlled by support_args.args.tpu_lanes.
+"""
 
 import logging
 from typing import List
 
-from ..smt.interval import must_be_false
+from ..smt.interval import state_infeasible
+from ..support.support_args import args
 
 log = logging.getLogger(__name__)
+
+# below this many states the host loop beats device dispatch overhead
+DEVICE_BATCH_THRESHOLD = 8
+
+# latched after the first hard device failure: a broken device path would
+# otherwise pay a full DAG linearization before every host fallback
+_device_disabled = False
 
 
 def prefilter_world_states(open_states: List) -> List:
     """Drop world states with an interval-infeasible constraint. Sound:
     only provably-unsat states are removed."""
+    global _device_disabled
+    if (
+        args.tpu_lanes
+        and not _device_disabled
+        and len(open_states) >= DEVICE_BATCH_THRESHOLD
+    ):
+        try:
+            return _prefilter_device(open_states)
+        except Exception as e:  # fall back to host screening permanently
+            _device_disabled = True
+            log.warning(
+                "device interval screening failed (%s); falling back to "
+                "host screening for the rest of this run", e,
+            )
     out = []
     dropped = 0
     for ws in open_states:
-        memo = {}
         try:
-            infeasible = any(
-                must_be_false(c.raw, memo) for c in ws.constraints
-            )
+            infeasible = state_infeasible(list(ws.constraints))
         except Exception as e:
             log.debug("interval screening failed: %s", e)
             infeasible = False
@@ -35,4 +55,20 @@ def prefilter_world_states(open_states: List) -> List:
             out.append(ws)
     if dropped:
         log.info("interval pre-filter dropped %d open states", dropped)
+    return out
+
+
+def _prefilter_device(open_states: List) -> List:
+    from ..ops.intervals import prefilter_feasible
+
+    keep = prefilter_feasible(
+        [[c.raw for c in ws.constraints] for ws in open_states]
+    )
+    out = [ws for ws, k in zip(open_states, keep) if k]
+    dropped = len(open_states) - len(out)
+    if dropped:
+        log.info(
+            "device interval pre-filter dropped %d/%d open states",
+            dropped, len(open_states),
+        )
     return out

@@ -1,0 +1,509 @@
+"""Batched interval constraint evaluation on device.
+
+This is the TPU half of the `Constraints.is_possible` replacement promised
+in SURVEY.md §2.1/§2.10 (solver-level row): the reference discharges every
+reachability check to Z3 (mythril/laser/ethereum/svm.py:244-252 open-state
+pruning; state/constraints.py:27 `is_possible`). Here, the union term DAG
+of many states' constraint systems is linearized host-side into
+level-synchronous tensors and abstractly evaluated on device with the same
+unsigned-interval transfer functions as the host prototype
+(mythril_tpu/smt/interval.py).
+
+The batching axis is the *state*: each state's syntactic variable bounds
+(smt.interval.extract_bounds — the cross-assertion seeding that catches
+contradictory branch conditions like x>10 ∧ x<5) seed that state's own
+copy of the interval table, so one device dispatch evaluates the shared
+DAG under S different variable environments at once: tables are
+(S, T, 2, 8) and every transfer function is vectorized over both the
+state axis and the level's node axis. A state is pruned when any of its
+assertions' may-be-true bits comes back 0 — sound by construction (the
+abstraction only ever over-approximates feasibility).
+
+Encoding details:
+- interval endpoints are 256-bit words in the bv256 8xuint32 limb format;
+  terms wider than 256 bits (post-SHA3 concats) are soundly topped;
+- a Bool abstraction (may_false, may_true) rides in limb 0 of the lo/hi
+  endpoint slots;
+- per-node static data is baked host-side: device opcode, three arg
+  indices (EXTRACT reuses two as bit-position immediates), a width mask
+  (2^w - 1), and an aux word (SEXT sign threshold, EXTRACT field mask,
+  CONCAT low-part width);
+- evaluation loops over topological levels; within a level every transfer
+  function runs vectorized and a per-node select keys on the opcode —
+  the same masked-family pattern as the lane stepper. MUL's 512-bit
+  product and UDIV's shift-subtract loops are lax.cond-gated per level.
+"""
+
+import logging
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..smt import terms as T
+from ..smt.interval import extract_bounds
+from . import bv256
+
+log = logging.getLogger(__name__)
+
+# device opcodes (NOP = leaf/unsupported: table keeps its host-seeded value)
+(
+    NOP, ADD, SUB, MUL, UDIV, UREM, BAND, BOR, BXOR, BNOT, NEG, SHL, LSHR,
+    COPY, SEXT, EXTRACT, CONCAT2, ITE, EQ, ULT, ULE, BAND2, BOR2, BNOT1,
+    BXOR2, BITE,
+) = range(26)
+
+_BINOP_MAP = {
+    T.ADD: ADD,
+    T.SUB: SUB,
+    T.MUL: MUL,
+    T.UDIV: UDIV,
+    T.UREM: UREM,
+    T.BAND: BAND,
+    T.BOR: BOR,
+    T.BXOR: BXOR,
+    T.SHL: SHL,
+    T.LSHR: LSHR,
+}
+
+
+class EncodedDAG:
+    """Host-side linearization of a term-DAG union into level tensors."""
+
+    def __init__(self, n_nodes, levels, init_lo, init_hi, seed_idx, seed_lo,
+                 seed_hi, dead, assert_idx, assert_mask):
+        self.n_nodes = n_nodes
+        self.levels = levels  # list of dicts of per-level arrays
+        self.init_lo = init_lo  # (T, 8) uint32 shared defaults
+        self.init_hi = init_hi
+        self.seed_idx = seed_idx  # (S, V) int32 node index (T = unused slot)
+        self.seed_lo = seed_lo  # (S, V, 8)
+        self.seed_hi = seed_hi
+        self.dead = dead  # (S,) bool — contradictory bounds, pre-pruned
+        self.assert_idx = assert_idx  # (S, A) int32 node index per assertion
+        self.assert_mask = assert_mask  # (S, A) bool
+
+
+def _word(v: int) -> np.ndarray:
+    return bv256.int_to_limbs(v)
+
+
+def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
+    """Topo-sort the union DAG, bake static node tensors, and extract the
+    per-state variable-bound seeds."""
+    assertion_sets = [
+        [getattr(t, "raw", t) for t in s] for s in assertion_sets
+    ]
+    # collect nodes iteratively (deep chains exceed recursion limits)
+    depth: Dict[int, int] = {}
+    nodes: Dict[int, "T.Term"] = {}
+    stack: List["T.Term"] = [t for s in assertion_sets for t in s]
+    while stack:
+        cur = stack[-1]
+        if cur.tid in depth:
+            stack.pop()
+            continue
+        pending = [a for a in cur.args if a.tid not in depth]
+        if pending:
+            stack.extend(pending)
+            continue
+        stack.pop()
+        d = 1 + max((depth[a.tid] for a in cur.args), default=0)
+        depth[cur.tid] = d
+        nodes[cur.tid] = cur
+
+    order = sorted(nodes.values(), key=lambda t: (depth[t.tid], t.tid))
+    index = {t.tid: i for i, t in enumerate(order)}
+    n = len(order)
+
+    init_lo = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
+    init_hi = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
+    dev_op = np.zeros(n, dtype=np.int32)
+    args = np.zeros((n, 3), dtype=np.int32)
+    mask_w = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
+    aux = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
+
+    for i, t in enumerate(order):
+        op = t.op
+        w = t.width if isinstance(t.width, int) else 0
+        wide = w > 256
+        if w and not wide:
+            mask_w[i] = _word((1 << w) - 1)
+        # default/seed abstraction
+        if op == T.BV_CONST:
+            if wide:
+                # a >256-bit constant must be topped, not truncated:
+                # truncation would manufacture a false tight interval and
+                # let comparisons prune satisfiable states. All wide
+                # nodes keep lo=0, so capped his can never mis-fire the
+                # disjointness/ordering tests.
+                init_hi[i] = _word((1 << 256) - 1)
+            else:
+                init_lo[i] = init_hi[i] = _word(t.val)
+        elif op == T.TRUE:
+            init_hi[i] = _word(1)  # (may_false=0, may_true=1)
+        elif op == T.FALSE:
+            init_lo[i] = _word(1)
+        elif t.is_bool:
+            init_lo[i] = _word(1)
+            init_hi[i] = _word(1)
+        elif w:
+            init_hi[i] = _word((1 << min(w, 256)) - 1)
+
+        for k, a in enumerate(t.args[:3]):
+            args[i, k] = index[a.tid]
+
+        if wide:
+            continue  # NOP: stays at top
+
+        if op in _BINOP_MAP:
+            dev_op[i] = _BINOP_MAP[op]
+        elif op == T.BNOT:
+            dev_op[i] = BNOT
+        elif op == T.NEG:
+            dev_op[i] = NEG
+        elif op == T.ZEXT:
+            dev_op[i] = COPY
+        elif op == T.SEXT:
+            iw = t.args[0].width
+            if isinstance(iw, int) and iw <= 256:
+                dev_op[i] = SEXT
+                aux[i] = _word(1 << (iw - 1))
+        elif op == T.EXTRACT:
+            hi_b, lo_b = t.params
+            dev_op[i] = EXTRACT
+            aux[i] = _word((1 << (hi_b - lo_b + 1)) - 1)
+            args[i, 1] = lo_b  # immediate, not a node index
+            args[i, 2] = hi_b
+        elif op == T.CONCAT:
+            # 2-ary concat only; n-ary stays at top (sound)
+            if len(t.args) == 2 and all(
+                isinstance(a.width, int) and a.width <= 256 for a in t.args
+            ):
+                dev_op[i] = CONCAT2
+                aux[i] = _word(t.args[1].width)
+        elif op == T.ITE:
+            dev_op[i] = ITE
+        elif op == T.EQ:
+            a, b = t.args
+            if not (a.is_array or b.is_array or a.is_bool or b.is_bool):
+                dev_op[i] = EQ
+        elif op == T.ULT:
+            dev_op[i] = ULT
+        elif op == T.ULE:
+            dev_op[i] = ULE
+        elif op == T.AND:
+            if len(t.args) == 2:
+                dev_op[i] = BAND2
+        elif op == T.OR:
+            if len(t.args) == 2:
+                dev_op[i] = BOR2
+        elif op == T.NOT:
+            dev_op[i] = BNOT1
+        elif op == T.XOR:
+            dev_op[i] = BXOR2
+        elif op == T.BOOL_ITE:
+            dev_op[i] = BITE
+        # everything else (vars, SELECT/APPLY, SDIV/SREM, SLT/SLE) stays
+        # NOP at its seeded default
+
+    # build level tensors (skip levels that are all NOP — usually leaves)
+    levels = []
+    start = 0
+    while start < n:
+        d = depth[order[start].tid]
+        end = start
+        while end < n and depth[order[end].tid] == d:
+            end += 1
+        idx = np.arange(start, end, dtype=np.int32)
+        if np.any(dev_op[idx] != NOP):
+            levels.append(
+                dict(
+                    node=jnp.asarray(idx),
+                    op=jnp.asarray(dev_op[idx]),
+                    args=jnp.asarray(args[idx]),
+                    mask=jnp.asarray(mask_w[idx]),
+                    aux=jnp.asarray(aux[idx]),
+                )
+            )
+        start = end
+
+    # per-state variable-bound seeds + assertion pointers
+    n_states = len(assertion_sets)
+    all_bounds = [extract_bounds(s) for s in assertion_sets]
+    max_v = max((len(b) for b in all_bounds), default=1) or 1
+    seed_idx = np.full((n_states, max_v), n, dtype=np.int32)
+    seed_lo = np.zeros((n_states, max_v, bv256.NLIMBS), dtype=np.uint32)
+    seed_hi = np.zeros((n_states, max_v, bv256.NLIMBS), dtype=np.uint32)
+    dead = np.zeros(n_states, dtype=bool)
+    for s, bounds in enumerate(all_bounds):
+        j = 0
+        for var, lo, hi in bounds.values():
+            if lo > hi:
+                dead[s] = True
+                break
+            if var.tid in index:
+                seed_idx[s, j] = index[var.tid]
+                seed_lo[s, j] = _word(lo)
+                seed_hi[s, j] = _word(hi)
+                j += 1
+
+    max_a = max((len(s) for s in assertion_sets), default=1) or 1
+    assert_idx = np.zeros((n_states, max_a), dtype=np.int32)
+    assert_mask = np.zeros((n_states, max_a), dtype=bool)
+    for s, assts in enumerate(assertion_sets):
+        for j, t in enumerate(assts):
+            assert_idx[s, j] = index[t.tid]
+            assert_mask[s, j] = True
+
+    return EncodedDAG(
+        n, levels, jnp.asarray(init_lo), jnp.asarray(init_hi),
+        jnp.asarray(seed_idx), jnp.asarray(seed_lo), jnp.asarray(seed_hi),
+        dead, jnp.asarray(assert_idx), jnp.asarray(assert_mask),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device evaluation
+# ---------------------------------------------------------------------------
+
+
+def _smear(x):
+    """All bits at/below the most significant set bit."""
+    for s in (1, 2, 4, 8, 16, 32, 64, 128):
+        x = x | bv256.shr(
+            x, bv256.from_u32(jnp.full(x.shape[:-1], s, jnp.uint32))
+        )
+    return x
+
+
+def _eval_level(level, lo_tab, hi_tab):
+    """Evaluate one level's nodes vectorized over (state, node) axes."""
+    op = level["op"]  # (W,)
+    node = level["node"]
+    argi = level["args"]
+    mask = level["mask"]  # (W, 8) — broadcasts against (S, W, 8)
+    aux = level["aux"]
+
+    def g(k):
+        return lo_tab[:, argi[:, k]], hi_tab[:, argi[:, k]]  # (S, W, 8)
+
+    alo, ahi = g(0)
+    blo, bhi = g(1)
+    clo, chi = g(2)
+    batch = alo.shape[:-1]  # (S, W)
+
+    top_lo = jnp.zeros_like(alo)
+    top_hi = jnp.broadcast_to(mask, alo.shape)
+
+    def iv(cond, lo, hi):
+        """Select refined (lo, hi) where cond, else top."""
+        c = cond[..., None]
+        return jnp.where(c, lo, top_lo), jnp.where(c, hi, top_hi)
+
+    # ADD
+    s_lo, s_hi = bv256.add(alo, blo), bv256.add(ahi, bhi)
+    add_ovf = bv256.ult(s_hi, ahi) | bv256.ugt(s_hi, top_hi)
+    add_lo, add_hi = iv(~add_ovf, s_lo, s_hi)
+    # SUB
+    can_sub = ~bv256.ult(alo, bhi)  # alo >= bhi
+    sub_lo, sub_hi = iv(can_sub, bv256.sub(alo, bhi), bv256.sub(ahi, blo))
+
+    # MUL (gated: costs a full 512-bit product)
+    def _mul():
+        plo, phi = bv256.mul_full(ahi, bhi)
+        ok = bv256.is_zero(phi) & ~bv256.ugt(plo, top_hi)
+        return iv(ok, bv256.mul(alo, blo), plo)
+
+    mul_lo, mul_hi = lax.cond(
+        jnp.any(op == MUL), _mul, lambda: (top_lo, top_hi)
+    )
+
+    # UDIV (gated: two shift-subtract loops)
+    def _udiv():
+        q1, _ = bv256.divmod_u(alo, bhi)
+        q2, _ = bv256.divmod_u(ahi, blo)
+        nz = ~bv256.is_zero(blo)
+        return iv(nz, q1, q2)
+
+    udiv_lo, udiv_hi = lax.cond(
+        jnp.any(op == UDIV), _udiv, lambda: (top_lo, top_hi)
+    )
+    # UREM: divisor may be 0 -> x % 0 = x (pass dividend interval)
+    one = bv256.from_u32(jnp.ones(batch, jnp.uint32))
+    bhi_m1 = bv256.sub(bhi, one)
+    div_zero = bv256.is_zero(bhi)[..., None]
+    urem_lo = jnp.where(div_zero, alo, top_lo)
+    urem_hi = jnp.where(
+        div_zero, ahi,
+        jnp.where(~bv256.is_zero(blo)[..., None], bhi_m1, top_hi),
+    )
+    # bitwise
+    band_lo = top_lo
+    band_hi = jnp.where(bv256.ult(ahi, bhi)[..., None], ahi, bhi)
+    or_smear = _smear(ahi) | _smear(bhi)
+    bor_lo = jnp.where(bv256.ult(alo, blo)[..., None], blo, alo)
+    bor_hi = jnp.where(
+        bv256.ult(or_smear, top_hi)[..., None], or_smear, top_hi
+    )
+    bxor_lo, bxor_hi = top_lo, bor_hi
+    bnot_lo, bnot_hi = bv256.sub(top_hi, ahi), bv256.sub(top_hi, alo)
+    # NEG: (-x) mod 2^w — (2^256 - x) & mask == (2^w - x) for 0 < x <= 2^w
+    zero = jnp.zeros_like(alo)
+    neg_exact = bv256.sub(zero, alo) & top_hi
+    neg_lo_c = bv256.sub(zero, ahi) & top_hi
+    neg_hi_c = bv256.sub(zero, alo) & top_hi
+    a_const = bv256.eq(alo, ahi)
+    a_pos = ~bv256.is_zero(alo)
+    neg_lo = jnp.where(a_const[..., None], neg_exact,
+                       jnp.where(a_pos[..., None], neg_lo_c, top_lo))
+    neg_hi = jnp.where(a_const[..., None], neg_exact,
+                       jnp.where(a_pos[..., None], neg_hi_c, top_hi))
+    # SHL: constant in-range shift without overflow
+    b_const = bv256.eq(blo, bhi)
+    shl_hi_t = bv256.shl(ahi, bhi)
+    shl_ok = (
+        b_const
+        & bv256.eq(bv256.shr(shl_hi_t, bhi), ahi)
+        & ~bv256.ugt(shl_hi_t, top_hi)
+    )
+    shl_lo, shl_hi = iv(shl_ok, bv256.shl(alo, blo), shl_hi_t)
+    # LSHR
+    lshr_lo, lshr_hi = bv256.shr(alo, bhi), bv256.shr(ahi, blo)
+    # SEXT: provably non-negative input passes through
+    sext_ok = bv256.ult(ahi, jnp.broadcast_to(aux, alo.shape))
+    sext_lo, sext_hi = iv(sext_ok, alo, ahi)
+    # EXTRACT: args[:,1]=lo_b, args[:,2]=hi_b immediates, aux = field mask
+    ext_mask = jnp.broadcast_to(aux, alo.shape)
+    lo_b = jnp.broadcast_to(
+        bv256.from_u32(argi[:, 1].astype(jnp.uint32)), alo.shape
+    )
+    hi_b1 = jnp.broadcast_to(
+        bv256.from_u32((argi[:, 2] + 1).astype(jnp.uint32)), alo.shape
+    )
+    same_high = bv256.eq(bv256.shr(alo, hi_b1), bv256.shr(ahi, hi_b1))
+    slo_f = bv256.shr(alo, lo_b)
+    shi_f = bv256.shr(ahi, lo_b)
+    diff_ok = ~bv256.ugt(bv256.sub(shi_f, slo_f), ext_mask)
+    slo_m = slo_f & ext_mask
+    shi_m = shi_f & ext_mask
+    ext_ok = same_high & diff_ok & ~bv256.ugt(slo_m, shi_m)
+    # node width == field width, so top for EXTRACT is ext_mask == mask
+    ext_lo, ext_hi = iv(ext_ok, slo_m, shi_m)
+    # CONCAT2: (a << low_width) | b, bit-disjoint
+    bw = jnp.broadcast_to(bv256.from_u32(aux[:, 0]), alo.shape)
+    cc_lo = bv256.shl(alo, bw) | blo
+    cc_hi = bv256.shl(ahi, bw) | bhi
+    # ITE(cond, a, b): cond bool abs rides in limb 0 of arg0's endpoints
+    c_mf = (alo[..., 0] != 0)[..., None]
+    c_mt = (ahi[..., 0] != 0)[..., None]
+    ite_lo = jnp.where(
+        ~c_mf, blo,
+        jnp.where(~c_mt, clo,
+                  jnp.where(bv256.ult(blo, clo)[..., None], blo, clo)),
+    )
+    ite_hi = jnp.where(
+        ~c_mf, bhi,
+        jnp.where(~c_mt, chi,
+                  jnp.where(bv256.ugt(bhi, chi)[..., None], bhi, chi)),
+    )
+
+    # comparisons -> bool abs
+    def mk_bool(mf, mt):
+        z = jnp.zeros(mf.shape + (bv256.NLIMBS,), jnp.uint32)
+        return (
+            z.at[..., 0].set(mf.astype(jnp.uint32)),
+            z.at[..., 0].set(mt.astype(jnp.uint32)),
+        )
+
+    disjoint = bv256.ult(ahi, blo) | bv256.ult(bhi, alo)
+    all_const = bv256.eq(alo, ahi) & bv256.eq(blo, bhi) & bv256.eq(alo, blo)
+    eq_lo, eq_hi = mk_bool(~all_const, ~disjoint)
+    lt_must = bv256.ult(ahi, blo)
+    lt_never = ~bv256.ult(alo, bhi)  # alo >= bhi
+    ult_lo, ult_hi = mk_bool(~lt_must, ~lt_never)
+    le_must = ~bv256.ugt(ahi, blo)  # ahi <= blo
+    le_never = bv256.ugt(alo, bhi)
+    ule_lo, ule_hi = mk_bool(~le_must, ~le_never)
+    # bool connectives (abs in limb 0)
+    amf, amt = alo[..., 0] != 0, ahi[..., 0] != 0
+    bmf, bmt = blo[..., 0] != 0, bhi[..., 0] != 0
+    cmf, cmt = clo[..., 0] != 0, chi[..., 0] != 0
+    and2_lo, and2_hi = mk_bool(amf | bmf, amt & bmt)
+    or2_lo, or2_hi = mk_bool(amf & bmf, amt | bmt)
+    not_lo, not_hi = mk_bool(amt, amf)
+    xor2_lo, xor2_hi = mk_bool(
+        (amt & bmt) | (amf & bmf), (amt & bmf) | (amf & bmt)
+    )
+    bite_lo, bite_hi = mk_bool(
+        (amt & bmf) | (amf & cmf), (amt & bmt) | (amf & cmt)
+    )
+
+    # select by opcode
+    cur_lo = lo_tab[:, node]
+    cur_hi = hi_tab[:, node]
+    out_lo, out_hi = cur_lo, cur_hi
+    for code, rlo, rhi in (
+        (ADD, add_lo, add_hi),
+        (SUB, sub_lo, sub_hi),
+        (MUL, mul_lo, mul_hi),
+        (UDIV, udiv_lo, udiv_hi),
+        (UREM, urem_lo, urem_hi),
+        (BAND, band_lo, band_hi),
+        (BOR, bor_lo, bor_hi),
+        (BXOR, bxor_lo, bxor_hi),
+        (BNOT, bnot_lo, bnot_hi),
+        (NEG, neg_lo, neg_hi),
+        (SHL, shl_lo, shl_hi),
+        (LSHR, lshr_lo, lshr_hi),
+        (COPY, alo, ahi),
+        (SEXT, sext_lo, sext_hi),
+        (EXTRACT, ext_lo, ext_hi),
+        (CONCAT2, cc_lo, cc_hi),
+        (ITE, ite_lo, ite_hi),
+        (EQ, eq_lo, eq_hi),
+        (ULT, ult_lo, ult_hi),
+        (ULE, ule_lo, ule_hi),
+        (BAND2, and2_lo, and2_hi),
+        (BOR2, or2_lo, or2_hi),
+        (BNOT1, not_lo, not_hi),
+        (BXOR2, xor2_lo, xor2_hi),
+        (BITE, bite_lo, bite_hi),
+    ):
+        m = (op == code)[None, :, None]
+        out_lo = jnp.where(m, rlo, out_lo)
+        out_hi = jnp.where(m, rhi, out_hi)
+
+    lo_tab = lo_tab.at[:, node].set(out_lo)
+    hi_tab = hi_tab.at[:, node].set(out_hi)
+    return lo_tab, hi_tab
+
+
+_eval_level_jit = jax.jit(_eval_level)
+
+
+def eval_feasible(enc: EncodedDAG) -> np.ndarray:
+    """Returns (n_states,) bool: True = state may be feasible (keep)."""
+    n_states = enc.assert_idx.shape[0]
+    shape = (n_states,) + enc.init_lo.shape
+    lo_tab = jnp.broadcast_to(enc.init_lo, shape)
+    hi_tab = jnp.broadcast_to(enc.init_hi, shape)
+    # scatter the per-state variable-bound seeds (index n == padded slot,
+    # dropped by scatter mode)
+    rows = jnp.arange(n_states)[:, None]
+    lo_tab = lo_tab.at[rows, enc.seed_idx].set(enc.seed_lo, mode="drop")
+    hi_tab = hi_tab.at[rows, enc.seed_idx].set(enc.seed_hi, mode="drop")
+    for level in enc.levels:
+        lo_tab, hi_tab = _eval_level_jit(level, lo_tab, hi_tab)
+    may_true = hi_tab[rows, enc.assert_idx][..., 0] != 0  # (S, A)
+    ok = np.asarray(jnp.all(may_true | ~enc.assert_mask, axis=1))
+    return ok & ~enc.dead
+
+
+def prefilter_feasible(assertion_sets) -> np.ndarray:
+    """Host entry: linearize + evaluate. Soundness: only provably-unsat
+    states report False."""
+    enc = linearize(assertion_sets)
+    return eval_feasible(enc)

@@ -599,18 +599,31 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
     is_invalid = op == _OP["INVALID"]
     is_sd = op == _OP["SELFDESTRUCT"]
 
-    ret_off_u32, _ = _u32_of(a)
-    ret_len_u32, _ = _u32_of(b)
-    ret_offset = jnp.where(
-        running & (is_return | is_revert),
-        ret_off_u32.astype(jnp.int32),
-        st.ret_offset,
+    ret_off_u32, ret_off_hi = _u32_of(a)
+    ret_len_u32, ret_len_hi = _u32_of(b)
+    # RETURN/REVERT touching memory beyond the fixed device buffer (or
+    # with offsets past int32-safe range) must park for the host engine:
+    # completing the lane would hand corrupted/truncated return data to
+    # the symbolic resume. A zero-length return never touches memory and
+    # is always valid. (Real EVM semantics: the range is zero-filled on
+    # expansion; within the buffer our pre-zeroed memory matches.)
+    ret_big = (
+        ret_off_hi | ret_len_hi
+        | (ret_off_u32 >= jnp.uint32(1 << 30))
+        | (ret_len_u32 >= jnp.uint32(1 << 30))
     )
-    ret_len = jnp.where(
-        running & (is_return | is_revert),
-        ret_len_u32.astype(jnp.int32),
-        st.ret_len,
+    ret_len_nz = ~bv256.is_zero(b)
+    ret_off_i = jnp.where(ret_big, 0, ret_off_u32).astype(jnp.int32)
+    ret_len_i = jnp.where(ret_big, 0, ret_len_u32).astype(jnp.int32)
+    ret_oob = (
+        (is_return | is_revert)
+        & ret_len_nz
+        & (ret_big | (ret_off_i + ret_len_i > mem_bytes))
+        & ~underflow
     )
+    do_ret = running & (is_return | is_revert) & ~ret_oob
+    ret_offset = jnp.where(do_ret, ret_off_i, st.ret_offset)
+    ret_len = jnp.where(do_ret, ret_len_i, st.ret_len)
 
     # ---- status resolution ----------------------------------------------
     status = st.status
@@ -620,11 +633,11 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
         nonlocal status
         status = jnp.where(running & cond, code_, status)
 
-    mark(parked, Status.NEEDS_HOST)
+    mark(parked | ret_oob, Status.NEEDS_HOST)
     mark(underflow | bad_jump | is_invalid | oog, Status.INVALID)
     mark(is_stop, Status.STOPPED)  # includes the off-code-end STOP pad
-    mark(is_return, Status.RETURNED)
-    mark(is_revert, Status.REVERTED)
+    mark(is_return & ~ret_oob, Status.RETURNED)
+    mark(is_revert & ~ret_oob, Status.REVERTED)
     mark(is_sd, Status.SELFDESTRUCT)
 
     advanced = status == Status.RUNNING  # still running after this op

@@ -251,3 +251,113 @@ def must_be_false(t: "T.Term", memo=None) -> bool:
 def must_be_true(t: "T.Term", memo=None) -> bool:
     mf, mt = interval(t, memo)
     return not mf
+
+
+# ---------------------------------------------------------------------------
+# cross-assertion screening: variable-bound seeding
+# ---------------------------------------------------------------------------
+#
+# Screening each assertion in isolation misses the dominant infeasibility
+# shape in LASER paths: contradictory branch conditions over the same
+# symbol (x > 10 on one JUMPI, x < 5 on a later one). Before evaluating, we
+# scan the whole constraint system for syntactic `var <cmp> const` facts
+# (through conjunctions and negations), intersect them into per-variable
+# bounds, and seed the memo with the narrowed intervals so the forward
+# pass sees them. Mirrored on device by mythril_tpu/ops/intervals.py.
+
+
+def extract_bounds(assertions) -> Dict[int, Tuple["T.Term", int, int]]:
+    """{var_tid: (var_term, lo, hi)} from syntactic var-vs-const facts.
+
+    An empty range (lo > hi) marks the whole system infeasible."""
+    bounds: Dict[int, Tuple["T.Term", int, int]] = {}
+
+    def note(var, lo, hi):
+        old = bounds.get(var.tid)
+        if old is None:
+            w = var.width if isinstance(var.width, int) else 256
+            olo, ohi = 0, (1 << w) - 1
+        else:
+            _, olo, ohi = old
+        bounds[var.tid] = (var, max(lo, olo), min(hi, ohi))
+
+    def visit(t, positive=True):
+        op = t.op
+        if op == T.NOT:
+            visit(t.args[0], not positive)
+            return
+        if op == T.AND and positive:
+            for a in t.args:
+                visit(a, True)
+            return
+        if op == T.OR and not positive:
+            # not(a or b) == not a and not b
+            for a in t.args:
+                visit(a, False)
+            return
+        if op not in (T.ULT, T.ULE, T.EQ):
+            return
+        a, b = t.args
+        av, bv = a.op == T.BV_VAR, b.op == T.BV_VAR
+        ac, bc = a.op == T.BV_CONST, b.op == T.BV_CONST
+        w = a.width if isinstance(a.width, int) else 0
+        if not w:
+            return
+        m = (1 << w) - 1
+        if op == T.EQ and positive:
+            if av and bc:
+                note(a, b.val, b.val)
+            elif bv and ac:
+                note(b, a.val, a.val)
+            else:
+                # var (+/-) const == const is exact under wrap-around:
+                # x + c == k  <=>  x == (k - c) mod 2^w
+                for lhs, rhs in ((a, b), (b, a)):
+                    if rhs.op != T.BV_CONST or lhs.op not in (T.ADD, T.SUB):
+                        continue
+                    p, q = lhs.args
+                    if lhs.op == T.ADD and p.op == T.BV_VAR and q.op == T.BV_CONST:
+                        note(p, (rhs.val - q.val) & m, (rhs.val - q.val) & m)
+                    elif lhs.op == T.ADD and q.op == T.BV_VAR and p.op == T.BV_CONST:
+                        note(q, (rhs.val - p.val) & m, (rhs.val - p.val) & m)
+                    elif lhs.op == T.SUB and p.op == T.BV_VAR and q.op == T.BV_CONST:
+                        note(p, (rhs.val + q.val) & m, (rhs.val + q.val) & m)
+        elif op == T.ULT:
+            if positive:
+                if av and bc:  # a < c
+                    note(a, 0, b.val - 1)
+                elif ac and bv:  # c < b
+                    note(b, a.val + 1, m)
+            else:  # not(a < b) == a >= b
+                if av and bc:
+                    note(a, b.val, m)
+                elif ac and bv:
+                    note(b, 0, a.val)
+        elif op == T.ULE:
+            if positive:
+                if av and bc:
+                    note(a, 0, b.val)
+                elif ac and bv:
+                    note(b, a.val, m)
+            else:  # not(a <= b) == a > b
+                if av and bc:
+                    note(a, b.val + 1, m)
+                elif ac and bv:
+                    note(b, 0, a.val - 1)
+
+    for t in assertions:
+        visit(getattr(t, "raw", t), True)
+    return bounds
+
+
+def state_infeasible(assertions) -> bool:
+    """True iff the constraint system is provably unsat in the interval
+    domain with variable-bound seeding. Sound: never prunes a sat system."""
+    raw = [getattr(t, "raw", t) for t in assertions]
+    bounds = extract_bounds(raw)
+    memo: Dict[int, object] = {}
+    for var, lo, hi in bounds.values():
+        if lo > hi:
+            return True  # contradictory bounds on one variable
+        memo[var.tid] = (lo, hi)
+    return any(must_be_false(t, memo) for t in raw)

@@ -4,7 +4,20 @@ import sys
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 # exercised without TPU hardware (the driver separately dry-runs the real
 # chip path). Must be set before jax import.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the 8-device virtual CPU mesh. Env vars alone are NOT enough here:
+# the environment's sitecustomize pre-imports jax with JAX_PLATFORMS=axon
+# (the one real tunneled TPU chip) before this file runs, which would make
+# every test compile against it and hide multi-device sharding bugs. The
+# backend is still uninitialized at conftest time, so jax.config wins. The
+# driver exercises the real-chip path separately via __graft_entry__.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (pre-imported by sitecustomize; config still open)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

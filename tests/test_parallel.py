@@ -1,0 +1,146 @@
+"""Lane sharding over a multi-device mesh (8 virtual CPU devices).
+
+Validates the SURVEY.md §2.10 scale-out rows: SPMD stepper execution over
+a sharded lane batch must be bit-identical to single-device execution;
+collective lane accounting and work-stealing rebalance must preserve lane
+contents while evening out live lanes across shards.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import bv256, stepper
+from mythril_tpu.parallel import mesh as pmesh
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+
+def asm(*parts) -> bytes:
+    out = bytearray()
+    for p in parts:
+        if isinstance(p, str):
+            out.append(OP[p])
+        else:
+            out.extend(p)
+    return bytes(out)
+
+
+def push(v, n=1):
+    return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    return jax.devices()[:8]
+
+
+# program: out = (cd0 * 3 + 7) stored to slot 1, then loops cd0 % 8 times
+CODE = None
+
+
+def build_code():
+    code = bytearray()
+    code += asm(push(0), "CALLDATALOAD")                   # [x]
+    code += asm("DUP1", push(3), "MUL", push(7), "ADD")    # [x, y]
+    code += asm(push(1), "SSTORE")                         # sstore(1, y)
+    code += asm(push(8), "SWAP1", "MOD")                   # [x%8]
+    loop = len(code)
+    code += asm("JUMPDEST", "DUP1", "ISZERO")
+    code += asm(push(0), "JUMPI")                          # patched
+    patch = len(code) - 2
+    code += asm(push(1), "SWAP1", "SUB")
+    code += asm(push(loop), "JUMP")
+    done = len(code)
+    code += asm("JUMPDEST", "POP", "STOP")
+    code[patch] = done
+    return bytes(code)
+
+
+def make_batch(n):
+    cc = stepper.compile_code(build_code())
+    st = stepper.init_lanes(n, stack_depth=16, memory_bytes=64,
+                            storage_slots=8, calldata_bytes=32)
+    for i in range(n):
+        st = stepper.set_calldata(st, i, int.to_bytes(i * 977 + 5, 32, "big"))
+    return cc, st
+
+
+def test_sharded_run_matches_single_device(eight_devices):
+    n = 64
+    cc, st = make_batch(n)
+    # single-device reference
+    ref = stepper.run(cc, st, 200)
+    # sharded over the 8-device mesh
+    m = pmesh.make_mesh(8)
+    st_sh = pmesh.shard_lanes(st, m)
+    cc_rep = pmesh.replicate_code(cc, m)
+    out = pmesh.sharded_run(cc_rep, st_sh, 200, m)
+    for field in ("pc", "sp", "status", "scount", "gas_used"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, field)), np.asarray(getattr(out, field)),
+            err_msg=field,
+        )
+    np.testing.assert_array_equal(np.asarray(ref.stack), np.asarray(out.stack))
+    np.testing.assert_array_equal(np.asarray(ref.svals), np.asarray(out.svals))
+    # verify results concretely on a few lanes
+    for i in (0, 13, 63):
+        x = i * 977 + 5
+        assert stepper.extract_storage(out, i)[1] == (x * 3 + 7) % (1 << 256)
+        assert int(out.status[i]) == stepper.Status.STOPPED
+
+
+def test_live_lane_counts(eight_devices):
+    n = 64
+    cc, st = make_batch(n)
+    m = pmesh.make_mesh(8)
+    st_sh = pmesh.shard_lanes(st, m)
+    per_dev, total = pmesh.live_lane_counts(st_sh, m)
+    assert total == 64
+    assert per_dev.tolist() == [8] * 8
+    # halt lanes 0..31 -> uneven per-device liveness
+    status = np.asarray(st.status).copy()
+    status[:32] = stepper.Status.STOPPED
+    st2 = pmesh.shard_lanes(st._replace(status=jnp.asarray(status)), m)
+    per_dev, total = pmesh.live_lane_counts(st2, m)
+    assert total == 32
+    assert per_dev.tolist() == [0, 0, 0, 0, 8, 8, 8, 8]
+
+
+def test_steal_balance_evens_out_live_lanes(eight_devices):
+    n = 64
+    cc, st = make_batch(n)
+    status = np.asarray(st.status).copy()
+    status[:32] = stepper.Status.STOPPED  # first 4 devices all dead
+    st = st._replace(status=jnp.asarray(status))
+    m = pmesh.make_mesh(8)
+    st_sh = pmesh.shard_lanes(st, m)
+    bal = pmesh.steal_balance(st_sh, m)
+    per_dev, total = pmesh.live_lane_counts(bal, m)
+    assert total == 32
+    assert per_dev.tolist() == [4] * 8
+    # lane payloads must be preserved (same multiset of calldata words)
+    before = sorted(
+        bv256.limbs_to_int(np.asarray(stepper.bytes_be_to_word(
+            st.calldata[i, :32].astype(jnp.uint8)))) for i in range(n)
+    )
+    after = sorted(
+        bv256.limbs_to_int(np.asarray(stepper.bytes_be_to_word(
+            bal.calldata[i, :32].astype(jnp.uint8)))) for i in range(n)
+    )
+    assert before == after
+
+
+def test_compact_lanes():
+    n = 16
+    cc, st = make_batch(n)
+    status = np.asarray(st.status).copy()
+    status[::2] = stepper.Status.STOPPED
+    st = st._replace(status=jnp.asarray(status))
+    packed = pmesh.compact_lanes(st)
+    assert np.asarray(packed.status)[:8].tolist() == [0] * 8
+    assert all(np.asarray(packed.status)[8:] == stepper.Status.STOPPED)

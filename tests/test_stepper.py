@@ -477,3 +477,28 @@ def test_random_programs_straightline():
             assert stepper.extract_stack(final, i) == [v % M for v in o.stack], (
                 trial, i
             )
+
+
+def test_return_beyond_buffer_parks_lane():
+    # RETURN over a range past the device memory buffer must park, not
+    # silently truncate (the host engine models unbounded memory)
+    code = asm(push(32), push(0x2000, 2), "RETURN")
+    cc = stepper.compile_code(code)
+    st = stepper.init_lanes(1, memory_bytes=4096)
+    final = stepper.run(cc, st, 10)
+    assert int(final.status[0]) == stepper.Status.NEEDS_HOST
+    # huge offsets (int32-unsafe) likewise
+    code = asm(push(32), push(2**32 + 5, 5), "RETURN")
+    final = stepper.run(stepper.compile_code(code), stepper.init_lanes(1), 10)
+    assert int(final.status[0]) == stepper.Status.NEEDS_HOST
+    # zero-length return with huge offset is valid (touches no memory)
+    code = asm(push(0), push(2**32 + 5, 5), "RETURN")
+    final = stepper.run(stepper.compile_code(code), stepper.init_lanes(1), 10)
+    assert int(final.status[0]) == stepper.Status.RETURNED
+    assert stepper.extract_return_data(final, 0) == b""
+    # in-buffer return of untouched memory yields zero bytes (EVM
+    # zero-fills on expansion; the pre-zeroed buffer matches)
+    code = asm(push(32), push(64), "RETURN")
+    final = stepper.run(stepper.compile_code(code), stepper.init_lanes(1), 10)
+    assert int(final.status[0]) == stepper.Status.RETURNED
+    assert stepper.extract_return_data(final, 0) == b"\x00" * 32
