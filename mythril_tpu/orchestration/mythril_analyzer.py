@@ -1,0 +1,141 @@
+"""Analysis orchestration (capability parity:
+mythril/mythril/mythril_analyzer.py:29-193 — copies CLI args into the
+global Args flags, runs SymExecWrapper + fire_lasers per contract with
+per-contract exception capture and KeyboardInterrupt partial results,
+statespace dump and graph HTML exports)."""
+
+import logging
+import traceback
+from typing import List, Optional
+
+from ..analysis.callgraph import generate_graph
+from ..analysis.report import Issue, Report
+from ..analysis.security import fire_lasers
+from ..analysis.symbolic import SymExecWrapper
+from ..analysis.traceexplore import get_serializable_statespace
+from ..smt.solver import SolverStatistics
+from ..support.loader import DynLoader
+from ..support.source_support import Source
+from ..support.support_args import args
+
+log = logging.getLogger(__name__)
+
+
+class MythrilAnalyzer:
+    def __init__(
+        self,
+        disassembler,
+        cmd_args,
+        strategy: str = "bfs",
+        address: Optional[str] = None,
+    ):
+        self.eth = disassembler.eth
+        self.contracts = disassembler.contracts or []
+        self.enable_online_lookup = disassembler.enable_online_lookup
+        self.use_onchain_data = not getattr(cmd_args, "no_onchain_data", True)
+        self.strategy = strategy
+        self.address = address
+        self.max_depth = getattr(cmd_args, "max_depth", 128)
+        self.execution_timeout = getattr(cmd_args, "execution_timeout", 86400)
+        self.loop_bound = getattr(cmd_args, "loop_bound", 3)
+        self.create_timeout = getattr(cmd_args, "create_timeout", 10)
+        self.disable_dependency_pruning = getattr(
+            cmd_args, "disable_dependency_pruning", False
+        )
+        self.custom_modules_directory = getattr(
+            cmd_args, "custom_modules_directory", ""
+        )
+        # mirror analysis-relevant flags into the process-global Args
+        # (reference mythril_analyzer.py:62-70)
+        args.pruning_factor = getattr(cmd_args, "pruning_factor", None)
+        args.solver_timeout = getattr(cmd_args, "solver_timeout", 10000)
+        args.parallel_solving = getattr(cmd_args, "parallel_solving", False)
+        args.unconstrained_storage = getattr(
+            cmd_args, "unconstrained_storage", False
+        )
+        args.call_depth_limit = getattr(cmd_args, "call_depth_limit", 3)
+        args.disable_dependency_pruning = self.disable_dependency_pruning
+        args.solver_log = getattr(cmd_args, "solver_log", None)
+        args.transaction_sequences = getattr(
+            cmd_args, "transaction_sequences", None
+        )
+        args.tpu_lanes = getattr(cmd_args, "tpu_lanes", args.tpu_lanes)
+        if args.pruning_factor is None:
+            args.pruning_factor = 1 if self.execution_timeout > 600 else 0
+
+    def _sym_exec(self, contract, modules, transaction_count):
+        return SymExecWrapper(
+            contract,
+            self.address,
+            self.strategy,
+            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
+            max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            loop_bound=self.loop_bound,
+            create_timeout=self.create_timeout,
+            transaction_count=transaction_count,
+            modules=modules or [],
+            compulsory_statespace=False,
+            disable_dependency_pruning=self.disable_dependency_pruning,
+            custom_modules_directory=self.custom_modules_directory,
+        )
+
+    def dump_statespace(self, contract=None) -> str:
+        sym = self._sym_exec_statespace(contract or self.contracts[0])
+        return get_serializable_statespace(sym)
+
+    def _sym_exec_statespace(self, contract):
+        return SymExecWrapper(
+            contract,
+            self.address,
+            self.strategy,
+            dynloader=DynLoader(self.eth, active=self.use_onchain_data),
+            max_depth=self.max_depth,
+            execution_timeout=self.execution_timeout,
+            create_timeout=self.create_timeout,
+            compulsory_statespace=True,
+            run_analysis_modules=False,
+        )
+
+    def graph_html(self, contract=None, enable_physics: bool = False,
+                   phrackify: bool = False, transaction_count: int = 2) -> str:
+        sym = self._sym_exec_statespace(contract or self.contracts[0])
+        return generate_graph(sym, physics=enable_physics,
+                              phrackify=phrackify)
+
+    def fire_lasers(self, modules: Optional[List[str]] = None,
+                    transaction_count: int = 2) -> Report:
+        """Analyze every loaded contract; issues and per-contract crashes
+        both land in the report."""
+        all_issues: List[Issue] = []
+        exceptions = []
+        execution_info = None
+        for contract in self.contracts:
+            try:
+                sym = self._sym_exec(contract, modules, transaction_count)
+                issues = fire_lasers(sym, modules)
+                execution_info = sym.execution_info
+                all_issues += issues
+            except KeyboardInterrupt:
+                log.critical("keyboard interrupt: flushing partial results")
+                break
+            except Exception:
+                log.exception(
+                    "exception during %s analysis", contract.name
+                )
+                exceptions.append(traceback.format_exc())
+        stats = SolverStatistics()
+        if getattr(stats, "enabled", False):
+            log.info("solver statistics: %s", stats)
+
+        source_data = Source()
+        source_data.get_source_from_contracts_list(self.contracts)
+        report = Report(
+            contracts=self.contracts,
+            exceptions=exceptions,
+            execution_info=execution_info,
+        )
+        for issue in all_issues:
+            issue.add_code_info(self.contracts)
+            report.append_issue(issue)
+        return report

@@ -139,6 +139,19 @@ class SignatureDB(object, metaclass=type):
         )
         self.conn.commit()
 
+    def import_solidity_abi(self, abi) -> None:
+        """Import function signatures from a compiled contract's ABI."""
+        from .support_utils import sha3
+
+        for entry in abi or []:
+            if entry.get("type") != "function":
+                continue
+            sig = "{}({})".format(
+                entry.get("name", ""),
+                ",".join(i.get("type", "") for i in entry.get("inputs", [])),
+            )
+            self.add("0x" + sha3(sig.encode())[:4].hex(), sig)
+
     def import_solidity_file(self, file_path: str,
                              solc_binary: str = "solc",
                              solc_settings_json: str = None) -> None:
