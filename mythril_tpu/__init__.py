@@ -1,0 +1,4 @@
+"""mythril_tpu: a TPU-native symbolic-execution security analyzer for EVM
+bytecode (capability parity with the Mythril reference; see SURVEY.md)."""
+
+__version__ = "0.1.0"

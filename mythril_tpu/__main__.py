@@ -1,0 +1,6 @@
+"""`python -m mythril_tpu` == the `myth` console script."""
+
+from .interfaces.cli import main
+
+if __name__ == "__main__":
+    main()

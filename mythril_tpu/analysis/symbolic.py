@@ -198,6 +198,8 @@ class SymExecWrapper:
                 world_state=world_state, target_address=address.value
             )
 
+        self.execution_info = self.laser.execution_info
+
         if not requires_statespace:
             return
 

@@ -8,6 +8,7 @@ from typing import Dict, List
 from ...analysis.solver import get_transaction_sequence
 from ...exceptions import UnsatError
 from ...smt import Not, simplify
+from ..state.annotation import StateAnnotation
 from ..state.global_state import GlobalState
 from ..transaction import tx_id_manager
 from . import CriterionSearchStrategy
@@ -15,7 +16,7 @@ from . import CriterionSearchStrategy
 log = logging.getLogger(__name__)
 
 
-class TraceAnnotation:
+class TraceAnnotation(StateAnnotation):
     """Annotation tracking the (pc-address) trace of a state."""
 
     def __init__(self, trace=None):

@@ -215,3 +215,25 @@ def _setup_global_state_for_execution(
     global_state.node = new_node
     new_node.states.append(global_state)
     laser_evm.work_list.append(global_state)
+
+
+def execute_transaction(laser_evm, callee_address: str = "",
+                        data: str = "", **kwargs) -> None:
+    """Dispatch a symbolic transaction by callee address: '' = creation
+    from `data`, else a symbolic message call to that address (reference
+    transaction/symbolic.py:246-264; used by concolic branch flipping,
+    where the re-run must be symbolic so JUMPIs fork and the deviating
+    path carries the negated branch constraint)."""
+    if callee_address == "":
+        for ws in laser_evm.open_states[:]:
+            execute_contract_creation(
+                laser_evm=laser_evm,
+                contract_initialization_code=data,
+                world_state=ws,
+            )
+        return
+    execute_message_call(
+        laser_evm=laser_evm,
+        callee_address=symbol_factory.BitVecVal(int(callee_address, 16),
+                                                256),
+    )

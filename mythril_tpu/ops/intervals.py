@@ -40,7 +40,6 @@ from typing import Dict, List, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from ..smt import terms as T
 from ..smt.interval import extract_bounds
@@ -88,6 +87,10 @@ class EncodedDAG:
 
 def _word(v: int) -> np.ndarray:
     return bv256.int_to_limbs(v)
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(x - 1, 0).bit_length()
 
 
 def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
@@ -209,7 +212,12 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
         # everything else (vars, SELECT/APPLY, SDIV/SREM, SLT/SLE) stays
         # NOP at its seeded default
 
-    # build level tensors (skip levels that are all NOP — usually leaves)
+    # build level tensors (skip levels that are all NOP — usually leaves).
+    # Width is padded to a power of two and each level records the set of
+    # opcodes it contains: the level kernel is jit-specialized per
+    # (ops_present, shapes) so tiny DAGs don't pay for the 512-bit MUL or
+    # the divmod shift-subtract loops unless those ops actually occur, and
+    # repeat shapes hit the jit cache.
     levels = []
     start = 0
     while start < n:
@@ -219,13 +227,30 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
             end += 1
         idx = np.arange(start, end, dtype=np.int32)
         if np.any(dev_op[idx] != NOP):
+            w = _next_pow2(len(idx))
+            pad = w - len(idx)
+            # pad rows: node index n scatters with mode="drop"; op NOP
+            node_p = np.concatenate(
+                [idx, np.full(pad, n, dtype=np.int32)])
+            op_p = np.concatenate(
+                [dev_op[idx], np.zeros(pad, dtype=np.int32)])
+            args_p = np.concatenate(
+                [args[idx], np.zeros((pad, 3), dtype=np.int32)])
+            mask_p = np.concatenate(
+                [mask_w[idx],
+                 np.zeros((pad, bv256.NLIMBS), dtype=np.uint32)])
+            aux_p = np.concatenate(
+                [aux[idx],
+                 np.zeros((pad, bv256.NLIMBS), dtype=np.uint32)])
             levels.append(
                 dict(
-                    node=jnp.asarray(idx),
-                    op=jnp.asarray(dev_op[idx]),
-                    args=jnp.asarray(args[idx]),
-                    mask=jnp.asarray(mask_w[idx]),
-                    aux=jnp.asarray(aux[idx]),
+                    node=jnp.asarray(node_p),
+                    op=jnp.asarray(op_p),
+                    args=jnp.asarray(args_p),
+                    mask=jnp.asarray(mask_p),
+                    aux=jnp.asarray(aux_p),
+                    ops_present=tuple(
+                        sorted(set(dev_op[idx].tolist()) - {NOP})),
                 )
             )
         start = end
@@ -279,20 +304,25 @@ def _smear(x):
     return x
 
 
-def _eval_level(level, lo_tab, hi_tab):
-    """Evaluate one level's nodes vectorized over (state, node) axes."""
+def _eval_level(level, lo_tab, hi_tab, ops_present):
+    """Evaluate one level's nodes vectorized over (state, node) axes.
+
+    `ops_present` is static: only the transfer functions for opcodes that
+    actually occur in the level are traced, so small DAGs never pay the
+    compile cost of the 512-bit product or the divmod shift-subtract
+    loops."""
     op = level["op"]  # (W,)
     node = level["node"]
     argi = level["args"]
     mask = level["mask"]  # (W, 8) — broadcasts against (S, W, 8)
     aux = level["aux"]
+    present = set(ops_present)
 
     def g(k):
         return lo_tab[:, argi[:, k]], hi_tab[:, argi[:, k]]  # (S, W, 8)
 
     alo, ahi = g(0)
     blo, bhi = g(1)
-    clo, chi = g(2)
     batch = alo.shape[:-1]  # (S, W)
 
     top_lo = jnp.zeros_like(alo)
@@ -303,114 +333,6 @@ def _eval_level(level, lo_tab, hi_tab):
         c = cond[..., None]
         return jnp.where(c, lo, top_lo), jnp.where(c, hi, top_hi)
 
-    # ADD
-    s_lo, s_hi = bv256.add(alo, blo), bv256.add(ahi, bhi)
-    add_ovf = bv256.ult(s_hi, ahi) | bv256.ugt(s_hi, top_hi)
-    add_lo, add_hi = iv(~add_ovf, s_lo, s_hi)
-    # SUB
-    can_sub = ~bv256.ult(alo, bhi)  # alo >= bhi
-    sub_lo, sub_hi = iv(can_sub, bv256.sub(alo, bhi), bv256.sub(ahi, blo))
-
-    # MUL (gated: costs a full 512-bit product)
-    def _mul():
-        plo, phi = bv256.mul_full(ahi, bhi)
-        ok = bv256.is_zero(phi) & ~bv256.ugt(plo, top_hi)
-        return iv(ok, bv256.mul(alo, blo), plo)
-
-    mul_lo, mul_hi = lax.cond(
-        jnp.any(op == MUL), _mul, lambda: (top_lo, top_hi)
-    )
-
-    # UDIV (gated: two shift-subtract loops)
-    def _udiv():
-        q1, _ = bv256.divmod_u(alo, bhi)
-        q2, _ = bv256.divmod_u(ahi, blo)
-        nz = ~bv256.is_zero(blo)
-        return iv(nz, q1, q2)
-
-    udiv_lo, udiv_hi = lax.cond(
-        jnp.any(op == UDIV), _udiv, lambda: (top_lo, top_hi)
-    )
-    # UREM: divisor may be 0 -> x % 0 = x (pass dividend interval)
-    one = bv256.from_u32(jnp.ones(batch, jnp.uint32))
-    bhi_m1 = bv256.sub(bhi, one)
-    div_zero = bv256.is_zero(bhi)[..., None]
-    urem_lo = jnp.where(div_zero, alo, top_lo)
-    urem_hi = jnp.where(
-        div_zero, ahi,
-        jnp.where(~bv256.is_zero(blo)[..., None], bhi_m1, top_hi),
-    )
-    # bitwise
-    band_lo = top_lo
-    band_hi = jnp.where(bv256.ult(ahi, bhi)[..., None], ahi, bhi)
-    or_smear = _smear(ahi) | _smear(bhi)
-    bor_lo = jnp.where(bv256.ult(alo, blo)[..., None], blo, alo)
-    bor_hi = jnp.where(
-        bv256.ult(or_smear, top_hi)[..., None], or_smear, top_hi
-    )
-    bxor_lo, bxor_hi = top_lo, bor_hi
-    bnot_lo, bnot_hi = bv256.sub(top_hi, ahi), bv256.sub(top_hi, alo)
-    # NEG: (-x) mod 2^w — (2^256 - x) & mask == (2^w - x) for 0 < x <= 2^w
-    zero = jnp.zeros_like(alo)
-    neg_exact = bv256.sub(zero, alo) & top_hi
-    neg_lo_c = bv256.sub(zero, ahi) & top_hi
-    neg_hi_c = bv256.sub(zero, alo) & top_hi
-    a_const = bv256.eq(alo, ahi)
-    a_pos = ~bv256.is_zero(alo)
-    neg_lo = jnp.where(a_const[..., None], neg_exact,
-                       jnp.where(a_pos[..., None], neg_lo_c, top_lo))
-    neg_hi = jnp.where(a_const[..., None], neg_exact,
-                       jnp.where(a_pos[..., None], neg_hi_c, top_hi))
-    # SHL: constant in-range shift without overflow
-    b_const = bv256.eq(blo, bhi)
-    shl_hi_t = bv256.shl(ahi, bhi)
-    shl_ok = (
-        b_const
-        & bv256.eq(bv256.shr(shl_hi_t, bhi), ahi)
-        & ~bv256.ugt(shl_hi_t, top_hi)
-    )
-    shl_lo, shl_hi = iv(shl_ok, bv256.shl(alo, blo), shl_hi_t)
-    # LSHR
-    lshr_lo, lshr_hi = bv256.shr(alo, bhi), bv256.shr(ahi, blo)
-    # SEXT: provably non-negative input passes through
-    sext_ok = bv256.ult(ahi, jnp.broadcast_to(aux, alo.shape))
-    sext_lo, sext_hi = iv(sext_ok, alo, ahi)
-    # EXTRACT: args[:,1]=lo_b, args[:,2]=hi_b immediates, aux = field mask
-    ext_mask = jnp.broadcast_to(aux, alo.shape)
-    lo_b = jnp.broadcast_to(
-        bv256.from_u32(argi[:, 1].astype(jnp.uint32)), alo.shape
-    )
-    hi_b1 = jnp.broadcast_to(
-        bv256.from_u32((argi[:, 2] + 1).astype(jnp.uint32)), alo.shape
-    )
-    same_high = bv256.eq(bv256.shr(alo, hi_b1), bv256.shr(ahi, hi_b1))
-    slo_f = bv256.shr(alo, lo_b)
-    shi_f = bv256.shr(ahi, lo_b)
-    diff_ok = ~bv256.ugt(bv256.sub(shi_f, slo_f), ext_mask)
-    slo_m = slo_f & ext_mask
-    shi_m = shi_f & ext_mask
-    ext_ok = same_high & diff_ok & ~bv256.ugt(slo_m, shi_m)
-    # node width == field width, so top for EXTRACT is ext_mask == mask
-    ext_lo, ext_hi = iv(ext_ok, slo_m, shi_m)
-    # CONCAT2: (a << low_width) | b, bit-disjoint
-    bw = jnp.broadcast_to(bv256.from_u32(aux[:, 0]), alo.shape)
-    cc_lo = bv256.shl(alo, bw) | blo
-    cc_hi = bv256.shl(ahi, bw) | bhi
-    # ITE(cond, a, b): cond bool abs rides in limb 0 of arg0's endpoints
-    c_mf = (alo[..., 0] != 0)[..., None]
-    c_mt = (ahi[..., 0] != 0)[..., None]
-    ite_lo = jnp.where(
-        ~c_mf, blo,
-        jnp.where(~c_mt, clo,
-                  jnp.where(bv256.ult(blo, clo)[..., None], blo, clo)),
-    )
-    ite_hi = jnp.where(
-        ~c_mf, bhi,
-        jnp.where(~c_mt, chi,
-                  jnp.where(bv256.ugt(bhi, chi)[..., None], bhi, chi)),
-    )
-
-    # comparisons -> bool abs
     def mk_bool(mf, mt):
         z = jnp.zeros(mf.shape + (bv256.NLIMBS,), jnp.uint32)
         return (
@@ -418,88 +340,222 @@ def _eval_level(level, lo_tab, hi_tab):
             z.at[..., 0].set(mt.astype(jnp.uint32)),
         )
 
-    disjoint = bv256.ult(ahi, blo) | bv256.ult(bhi, alo)
-    all_const = bv256.eq(alo, ahi) & bv256.eq(blo, bhi) & bv256.eq(alo, blo)
-    eq_lo, eq_hi = mk_bool(~all_const, ~disjoint)
-    lt_must = bv256.ult(ahi, blo)
-    lt_never = ~bv256.ult(alo, bhi)  # alo >= bhi
-    ult_lo, ult_hi = mk_bool(~lt_must, ~lt_never)
-    le_must = ~bv256.ugt(ahi, blo)  # ahi <= blo
-    le_never = bv256.ugt(alo, bhi)
-    ule_lo, ule_hi = mk_bool(~le_must, ~le_never)
-    # bool connectives (abs in limb 0)
-    amf, amt = alo[..., 0] != 0, ahi[..., 0] != 0
-    bmf, bmt = blo[..., 0] != 0, bhi[..., 0] != 0
-    cmf, cmt = clo[..., 0] != 0, chi[..., 0] != 0
-    and2_lo, and2_hi = mk_bool(amf | bmf, amt & bmt)
-    or2_lo, or2_hi = mk_bool(amf & bmf, amt | bmt)
-    not_lo, not_hi = mk_bool(amt, amf)
-    xor2_lo, xor2_hi = mk_bool(
-        (amt & bmt) | (amf & bmf), (amt & bmf) | (amf & bmt)
-    )
-    bite_lo, bite_hi = mk_bool(
-        (amt & bmf) | (amf & cmf), (amt & bmt) | (amf & cmt)
-    )
+    results = {}  # code -> (lo, hi)
 
-    # select by opcode
-    cur_lo = lo_tab[:, node]
-    cur_hi = hi_tab[:, node]
+    if ADD in present:
+        s_lo, s_hi = bv256.add(alo, blo), bv256.add(ahi, bhi)
+        add_ovf = bv256.ult(s_hi, ahi) | bv256.ugt(s_hi, top_hi)
+        results[ADD] = iv(~add_ovf, s_lo, s_hi)
+    if SUB in present:
+        can_sub = ~bv256.ult(alo, bhi)  # alo >= bhi
+        results[SUB] = iv(
+            can_sub, bv256.sub(alo, bhi), bv256.sub(ahi, blo))
+    if MUL in present:
+        plo, phi = bv256.mul_full(ahi, bhi)
+        ok = bv256.is_zero(phi) & ~bv256.ugt(plo, top_hi)
+        results[MUL] = iv(ok, bv256.mul(alo, blo), plo)
+    if UDIV in present:
+        q1, _ = bv256.divmod_u(alo, bhi)
+        q2, _ = bv256.divmod_u(ahi, blo)
+        results[UDIV] = iv(~bv256.is_zero(blo), q1, q2)
+    if UREM in present:
+        # divisor may be 0 -> x % 0 = x (pass dividend interval)
+        one = bv256.from_u32(jnp.ones(batch, jnp.uint32))
+        bhi_m1 = bv256.sub(bhi, one)
+        div_zero = bv256.is_zero(bhi)[..., None]
+        urem_lo = jnp.where(div_zero, alo, top_lo)
+        urem_hi = jnp.where(
+            div_zero, ahi,
+            jnp.where(~bv256.is_zero(blo)[..., None], bhi_m1, top_hi),
+        )
+        results[UREM] = (urem_lo, urem_hi)
+    if BAND in present:
+        results[BAND] = (
+            top_lo, jnp.where(bv256.ult(ahi, bhi)[..., None], ahi, bhi))
+    if BOR in present or BXOR in present:
+        or_smear = _smear(ahi) | _smear(bhi)
+        bor_hi = jnp.where(
+            bv256.ult(or_smear, top_hi)[..., None], or_smear, top_hi
+        )
+        if BOR in present:
+            results[BOR] = (
+                jnp.where(bv256.ult(alo, blo)[..., None], blo, alo),
+                bor_hi,
+            )
+        if BXOR in present:
+            results[BXOR] = (top_lo, bor_hi)
+    if BNOT in present:
+        results[BNOT] = (bv256.sub(top_hi, ahi), bv256.sub(top_hi, alo))
+    if NEG in present:
+        # (-x) mod 2^w — (2^256 - x) & mask == (2^w - x) for 0 < x <= 2^w
+        zero = jnp.zeros_like(alo)
+        neg_exact = bv256.sub(zero, alo) & top_hi
+        neg_lo_c = bv256.sub(zero, ahi) & top_hi
+        neg_hi_c = bv256.sub(zero, alo) & top_hi
+        a_const = bv256.eq(alo, ahi)
+        a_pos = ~bv256.is_zero(alo)
+        results[NEG] = (
+            jnp.where(a_const[..., None], neg_exact,
+                      jnp.where(a_pos[..., None], neg_lo_c, top_lo)),
+            jnp.where(a_const[..., None], neg_exact,
+                      jnp.where(a_pos[..., None], neg_hi_c, top_hi)),
+        )
+    if SHL in present:
+        # constant in-range shift without overflow
+        b_const = bv256.eq(blo, bhi)
+        shl_hi_t = bv256.shl(ahi, bhi)
+        shl_ok = (
+            b_const
+            & bv256.eq(bv256.shr(shl_hi_t, bhi), ahi)
+            & ~bv256.ugt(shl_hi_t, top_hi)
+        )
+        results[SHL] = iv(shl_ok, bv256.shl(alo, blo), shl_hi_t)
+    if LSHR in present:
+        results[LSHR] = (bv256.shr(alo, bhi), bv256.shr(ahi, blo))
+    if COPY in present:
+        results[COPY] = (alo, ahi)
+    if SEXT in present:
+        # provably non-negative input passes through
+        sext_ok = bv256.ult(ahi, jnp.broadcast_to(aux, alo.shape))
+        results[SEXT] = iv(sext_ok, alo, ahi)
+    if EXTRACT in present:
+        # args[:,1]=lo_b, args[:,2]=hi_b immediates, aux = field mask
+        ext_mask = jnp.broadcast_to(aux, alo.shape)
+        lo_b = jnp.broadcast_to(
+            bv256.from_u32(argi[:, 1].astype(jnp.uint32)), alo.shape
+        )
+        hi_b1 = jnp.broadcast_to(
+            bv256.from_u32((argi[:, 2] + 1).astype(jnp.uint32)), alo.shape
+        )
+        same_high = bv256.eq(
+            bv256.shr(alo, hi_b1), bv256.shr(ahi, hi_b1))
+        slo_f = bv256.shr(alo, lo_b)
+        shi_f = bv256.shr(ahi, lo_b)
+        diff_ok = ~bv256.ugt(bv256.sub(shi_f, slo_f), ext_mask)
+        slo_m = slo_f & ext_mask
+        shi_m = shi_f & ext_mask
+        ext_ok = same_high & diff_ok & ~bv256.ugt(slo_m, shi_m)
+        # node width == field width, so top for EXTRACT is ext_mask == mask
+        results[EXTRACT] = iv(ext_ok, slo_m, shi_m)
+    if CONCAT2 in present:
+        # (a << low_width) | b, bit-disjoint
+        bw = jnp.broadcast_to(bv256.from_u32(aux[:, 0]), alo.shape)
+        results[CONCAT2] = (
+            bv256.shl(alo, bw) | blo, bv256.shl(ahi, bw) | bhi)
+    if ITE in present:
+        # ITE(cond, a, b): cond bool abs rides in limb 0 of arg0
+        clo, chi = g(2)
+        c_mf = (alo[..., 0] != 0)[..., None]
+        c_mt = (ahi[..., 0] != 0)[..., None]
+        results[ITE] = (
+            jnp.where(
+                ~c_mf, blo,
+                jnp.where(~c_mt, clo,
+                          jnp.where(bv256.ult(blo, clo)[..., None],
+                                    blo, clo)),
+            ),
+            jnp.where(
+                ~c_mf, bhi,
+                jnp.where(~c_mt, chi,
+                          jnp.where(bv256.ugt(bhi, chi)[..., None],
+                                    bhi, chi)),
+            ),
+        )
+
+    # comparisons -> bool abs
+    if EQ in present:
+        disjoint = bv256.ult(ahi, blo) | bv256.ult(bhi, alo)
+        all_const = (
+            bv256.eq(alo, ahi) & bv256.eq(blo, bhi) & bv256.eq(alo, blo))
+        results[EQ] = mk_bool(~all_const, ~disjoint)
+    if ULT in present:
+        lt_must = bv256.ult(ahi, blo)
+        lt_never = ~bv256.ult(alo, bhi)  # alo >= bhi
+        results[ULT] = mk_bool(~lt_must, ~lt_never)
+    if ULE in present:
+        le_must = ~bv256.ugt(ahi, blo)  # ahi <= blo
+        le_never = bv256.ugt(alo, bhi)
+        results[ULE] = mk_bool(~le_must, ~le_never)
+    # bool connectives (abs in limb 0)
+    if present & {BAND2, BOR2, BNOT1, BXOR2, BITE}:
+        amf, amt = alo[..., 0] != 0, ahi[..., 0] != 0
+        bmf, bmt = blo[..., 0] != 0, bhi[..., 0] != 0
+        if BAND2 in present:
+            results[BAND2] = mk_bool(amf | bmf, amt & bmt)
+        if BOR2 in present:
+            results[BOR2] = mk_bool(amf & bmf, amt | bmt)
+        if BNOT1 in present:
+            results[BNOT1] = mk_bool(amt, amf)
+        if BXOR2 in present:
+            results[BXOR2] = mk_bool(
+                (amt & bmt) | (amf & bmf), (amt & bmf) | (amf & bmt))
+        if BITE in present:
+            clo, chi = g(2)
+            cmf, cmt = clo[..., 0] != 0, chi[..., 0] != 0
+            results[BITE] = mk_bool(
+                (amt & bmf) | (amf & cmf), (amt & bmt) | (amf & cmt))
+
+    # select by opcode (pad/NOP rows keep their current value; the final
+    # scatter drops pad rows via their out-of-range node index)
+    cur_lo = lo_tab[:, jnp.minimum(node, lo_tab.shape[1] - 1)]
+    cur_hi = hi_tab[:, jnp.minimum(node, hi_tab.shape[1] - 1)]
     out_lo, out_hi = cur_lo, cur_hi
-    for code, rlo, rhi in (
-        (ADD, add_lo, add_hi),
-        (SUB, sub_lo, sub_hi),
-        (MUL, mul_lo, mul_hi),
-        (UDIV, udiv_lo, udiv_hi),
-        (UREM, urem_lo, urem_hi),
-        (BAND, band_lo, band_hi),
-        (BOR, bor_lo, bor_hi),
-        (BXOR, bxor_lo, bxor_hi),
-        (BNOT, bnot_lo, bnot_hi),
-        (NEG, neg_lo, neg_hi),
-        (SHL, shl_lo, shl_hi),
-        (LSHR, lshr_lo, lshr_hi),
-        (COPY, alo, ahi),
-        (SEXT, sext_lo, sext_hi),
-        (EXTRACT, ext_lo, ext_hi),
-        (CONCAT2, cc_lo, cc_hi),
-        (ITE, ite_lo, ite_hi),
-        (EQ, eq_lo, eq_hi),
-        (ULT, ult_lo, ult_hi),
-        (ULE, ule_lo, ule_hi),
-        (BAND2, and2_lo, and2_hi),
-        (BOR2, or2_lo, or2_hi),
-        (BNOT1, not_lo, not_hi),
-        (BXOR2, xor2_lo, xor2_hi),
-        (BITE, bite_lo, bite_hi),
-    ):
+    for code, (rlo, rhi) in results.items():
         m = (op == code)[None, :, None]
         out_lo = jnp.where(m, rlo, out_lo)
         out_hi = jnp.where(m, rhi, out_hi)
 
-    lo_tab = lo_tab.at[:, node].set(out_lo)
-    hi_tab = hi_tab.at[:, node].set(out_hi)
+    lo_tab = lo_tab.at[:, node].set(out_lo, mode="drop")
+    hi_tab = hi_tab.at[:, node].set(out_hi, mode="drop")
     return lo_tab, hi_tab
 
 
-_eval_level_jit = jax.jit(_eval_level)
+_eval_level_jit = jax.jit(_eval_level, static_argnames=("ops_present",))
 
 
 def eval_feasible(enc: EncodedDAG) -> np.ndarray:
     """Returns (n_states,) bool: True = state may be feasible (keep)."""
     n_states = enc.assert_idx.shape[0]
-    shape = (n_states,) + enc.init_lo.shape
+    n = enc.n_nodes
+    # pad the state axis to a power of two so repeated batch sizes reuse
+    # compiled level kernels (pad rows: no seeds, no live assertions)
+    s_pad = _next_pow2(n_states)
+    seed_idx = np.asarray(enc.seed_idx)
+    seed_lo, seed_hi = np.asarray(enc.seed_lo), np.asarray(enc.seed_hi)
+    assert_idx = np.asarray(enc.assert_idx)
+    assert_mask = np.asarray(enc.assert_mask)
+    if s_pad != n_states:
+        extra = s_pad - n_states
+        seed_idx = np.concatenate(
+            [seed_idx,
+             np.full((extra, seed_idx.shape[1]), n, dtype=np.int32)])
+        seed_lo = np.concatenate(
+            [seed_lo, np.zeros((extra,) + seed_lo.shape[1:], np.uint32)])
+        seed_hi = np.concatenate(
+            [seed_hi, np.zeros((extra,) + seed_hi.shape[1:], np.uint32)])
+        assert_idx = np.concatenate(
+            [assert_idx,
+             np.zeros((extra, assert_idx.shape[1]), np.int32)])
+        assert_mask = np.concatenate(
+            [assert_mask,
+             np.zeros((extra, assert_mask.shape[1]), bool)])
+
+    shape = (s_pad,) + enc.init_lo.shape
     lo_tab = jnp.broadcast_to(enc.init_lo, shape)
     hi_tab = jnp.broadcast_to(enc.init_hi, shape)
     # scatter the per-state variable-bound seeds (index n == padded slot,
     # dropped by scatter mode)
-    rows = jnp.arange(n_states)[:, None]
-    lo_tab = lo_tab.at[rows, enc.seed_idx].set(enc.seed_lo, mode="drop")
-    hi_tab = hi_tab.at[rows, enc.seed_idx].set(enc.seed_hi, mode="drop")
+    rows = jnp.arange(s_pad)[:, None]
+    lo_tab = lo_tab.at[rows, seed_idx].set(seed_lo, mode="drop")
+    hi_tab = hi_tab.at[rows, seed_idx].set(seed_hi, mode="drop")
     for level in enc.levels:
-        lo_tab, hi_tab = _eval_level_jit(level, lo_tab, hi_tab)
-    may_true = hi_tab[rows, enc.assert_idx][..., 0] != 0  # (S, A)
-    ok = np.asarray(jnp.all(may_true | ~enc.assert_mask, axis=1))
-    return ok & ~enc.dead
+        arrays = {k: v for k, v in level.items() if k != "ops_present"}
+        lo_tab, hi_tab = _eval_level_jit(
+            arrays, lo_tab, hi_tab, ops_present=level["ops_present"]
+        )
+    may_true = hi_tab[rows, jnp.asarray(assert_idx)][..., 0] != 0  # (S, A)
+    ok = np.asarray(jnp.all(may_true | ~jnp.asarray(assert_mask), axis=1))
+    return ok[:n_states] & ~enc.dead
 
 
 def prefilter_feasible(assertion_sets) -> np.ndarray:

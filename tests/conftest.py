@@ -20,4 +20,9 @@ import jax  # noqa: E402  (pre-imported by sitecustomize; config still open)
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the interval/stepper kernels compile in
+# tens of seconds; caching them across test runs keeps the suite fast.
+jax.config.update("jax_compilation_cache_dir", "/tmp/mythril_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
