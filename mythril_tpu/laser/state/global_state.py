@@ -5,6 +5,7 @@ from copy import copy, deepcopy
 from typing import Dict, Iterable, List, Optional, Union
 
 from ...smt import BitVec, symbol_factory
+from ...support.eth_constants import FRAME_GAS_LIMIT
 from .annotation import StateAnnotation
 from .environment import Environment
 from .machine_state import MachineState
@@ -29,7 +30,9 @@ class GlobalState:
         self.world_state = world_state
         self.environment = environment
         self.mstate = (
-            machine_state if machine_state else MachineState(gas_limit=8000000)
+            machine_state
+            if machine_state
+            else MachineState(gas_limit=FRAME_GAS_LIMIT)
         )
         self.transaction_stack = transaction_stack if transaction_stack else []
         self.op_code = ""

@@ -23,6 +23,13 @@ GAS_NEWACCOUNT = 25000
 STACK_LIMIT = 1024
 BLOCK_GAS_LIMIT = 8000000
 
+# Default per-frame gas ceiling for a fresh MachineState (reference
+# parity: state/global_state.py:48 uses 1_000_000_000). Transaction-level
+# gas enforcement happens separately against transaction.gas_limit in
+# Instruction.check_gas_usage_limit; this frame ceiling only guards
+# against runaway memory-expansion fees.
+FRAME_GAS_LIMIT = 1_000_000_000
+
 
 def ceil32(x: int) -> int:
     return x if x % 32 == 0 else x + 32 - (x % 32)

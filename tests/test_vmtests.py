@@ -1,0 +1,178 @@
+"""EVM conformance: the official Ethereum VMTests corpus, replayed
+concretely through the engine (this build's analog of the reference's
+tests/laser/evm_testsuite/evm_test.py:75-238 driver — same shape: build a
+world state from `pre`, run a concrete message call, assert gas-interval
+containment and storage post-state equality).
+
+The JSON fixtures are the public Ethereum test vectors vendored by the
+reference; they are loaded read-only from the reference checkout and the
+whole module skips cleanly when that path is absent."""
+
+import binascii
+import json
+from datetime import datetime
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.state.world_state import WorldState
+from mythril_tpu.laser.svm import LaserEVM
+from mythril_tpu.laser.time_handler import time_handler
+from mythril_tpu.laser.transaction.concolic import execute_message_call
+from mythril_tpu.smt import Expression, symbol_factory
+from mythril_tpu.support.support_args import args
+
+VMTESTS_DIR = Path("/root/reference/tests/laser/evm_testsuite/VMTests")
+
+TEST_TYPES = [
+    "vmArithmeticTest",
+    "vmBitwiseLogicOperation",
+    "vmEnvironmentalInfo",
+    "vmPushDupSwapTest",
+    "vmTests",
+    "vmSha3Test",
+    "vmSystemOperations",
+    "vmRandomTest",
+    "vmIOandFlowOperations",
+]
+
+# same exclusions as the reference driver (evm_test.py:34-61): tests
+# requiring concrete block numbers / gas opcode support / unbounded loops
+IGNORED_TEST_NAMES = set(
+    ["gas0", "gas1", "log1MemExp"]
+    + [
+        "BlockNumberDynamicJumpi0",
+        "BlockNumberDynamicJumpi1",
+        "BlockNumberDynamicJump0_jumpdest2",
+        "DynamicJumpPathologicalTest0",
+        "BlockNumberDynamicJumpifInsidePushWithJumpDest",
+        "BlockNumberDynamicJumpiAfterStop",
+        "BlockNumberDynamicJumpifInsidePushWithoutJumpDest",
+        "BlockNumberDynamicJump0_jumpdest0",
+        "BlockNumberDynamicJumpi1_jumpdest",
+        "BlockNumberDynamicJumpiOutsideBoundary",
+        "DynamicJumpJD_DependsOnJumps1",
+    ]
+    + ["loop_stacklimit_1020", "loop_stacklimit_1021"]
+    + ["jumpTo1InstructionafterJump", "sstore_load_2", "jumpi_at_the_end"]
+)
+
+
+def load_test_data():
+    if not VMTESTS_DIR.exists():
+        return []
+    cases = []
+    for designation in TEST_TYPES:
+        for file_reference in sorted((VMTESTS_DIR / designation).iterdir()):
+            with file_reference.open() as f:
+                top_level = json.load(f)
+            for test_name, data in top_level.items():
+                if test_name in IGNORED_TEST_NAMES:
+                    continue
+                gas_after = data.get("gas")
+                gas_used = (
+                    int(data["exec"]["gas"], 16) - int(gas_after, 16)
+                    if gas_after is not None
+                    else None
+                )
+                cases.append(
+                    pytest.param(
+                        data.get("env"),
+                        data["pre"],
+                        data["exec"],
+                        gas_used,
+                        data.get("post", {}),
+                        id=f"{designation}-{test_name}",
+                    )
+                )
+    return cases
+
+
+def _storage_to_int(actual):
+    if isinstance(actual, Expression):
+        actual = actual.value
+        return 1 if actual is True else 0 if actual is False else actual
+    if isinstance(actual, bytes):
+        return int(binascii.b2a_hex(actual), 16)
+    if isinstance(actual, str):
+        return int(actual, 16)
+    return actual
+
+
+@pytest.mark.skipif(
+    not VMTESTS_DIR.exists(), reason="VMTests corpus not present"
+)
+@pytest.mark.parametrize(
+    "environment, pre_condition, action, gas_used, post_condition",
+    load_test_data(),
+)
+def test_vmtest(environment, pre_condition, action, gas_used,
+                post_condition):
+    world_state = WorldState()
+    args.unconstrained_storage = False
+    for address, details in pre_condition.items():
+        account = world_state.create_account(
+            balance=int(details["balance"], 16),
+            address=int(address, 16),
+            concrete_storage=True,
+            nonce=int(details["nonce"], 16),
+        )
+        account.code = Disassembly(details["code"][2:])
+        for key, value in details["storage"].items():
+            account.storage[
+                symbol_factory.BitVecVal(int(key, 16), 256)
+            ] = symbol_factory.BitVecVal(int(value, 16), 256)
+
+    time_handler.start_execution(10000)
+    laser_evm = LaserEVM(requires_statespace=False)
+    laser_evm.open_states = [world_state]
+    laser_evm.time = datetime.now()
+
+    final_states = execute_message_call(
+        laser_evm,
+        callee_address=symbol_factory.BitVecVal(
+            int(action["address"], 16), 256),
+        caller_address=symbol_factory.BitVecVal(
+            int(action["caller"], 16), 256),
+        origin_address=symbol_factory.BitVecVal(
+            int(action["origin"], 16), 256),
+        code=action["code"][2:],
+        gas_limit=int(action["gas"], 16),
+        data=binascii.a2b_hex(action["data"][2:]),
+        gas_price=int(action["gasPrice"], 16),
+        value=int(action["value"], 16),
+        track_gas=True,
+    )
+
+    # gas-interval containment (below block gas limit, like the reference)
+    if gas_used is not None and gas_used < int(
+        environment["currentGasLimit"], 16
+    ):
+        gas_min_max = [
+            (s.mstate.min_gas_used, s.mstate.max_gas_used)
+            for s in final_states
+        ]
+        assert all(lo <= hi for lo, hi in gas_min_max)
+        assert any(lo <= gas_used for lo, _ in gas_min_max)
+
+    if post_condition == {}:
+        # error / out-of-gas: the tx must not commit a world state
+        assert len(laser_evm.open_states) == 0
+        return
+
+    assert len(laser_evm.open_states) == 1
+    world_state = laser_evm.open_states[0]
+    for address, details in post_condition.items():
+        account = world_state[
+            symbol_factory.BitVecVal(int(address, 16), 256)
+        ]
+        assert account.nonce == int(details["nonce"], 16)
+        assert account.code.bytecode == details["code"][2:]
+        for index, value in details["storage"].items():
+            actual = account.storage[
+                symbol_factory.BitVecVal(int(index, 16), 256)
+            ]
+            assert _storage_to_int(actual) == int(value, 16), (
+                f"storage[{index}]"
+            )
