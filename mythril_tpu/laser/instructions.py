@@ -1242,7 +1242,19 @@ class Instruction:
             mstate.stack.append(0)
             return [global_state]
 
-        if any(not isinstance(b, int) for b in callee_code):
+        # memory bytes may be concrete BitVec(8) constants (MSTORE writes
+        # Extracts of the stored word); fold them before the symbolic check
+        folded_code = []
+        symbolic_code = False
+        for b in callee_code:
+            if isinstance(b, int):
+                folded_code.append(b)
+            elif b.value is not None:
+                folded_code.append(b.value)
+            else:
+                symbolic_code = True
+                break
+        if symbolic_code:
             log.debug("Symbolic creation code; treating result as symbolic")
             mstate.stack.append(
                 global_state.new_bitvec(
@@ -1251,7 +1263,7 @@ class Instruction:
             )
             return [global_state]
 
-        code_raw = bytes(callee_code)
+        code_raw = bytes(folded_code)
         code_str = code_raw.hex()
         caller = environment.active_account.address
         gas_price = environment.gasprice

@@ -1,0 +1,249 @@
+"""Per-opcode semantics not covered by the (Frontier-era) VMTests corpus:
+EIP-145 shifts, CREATE/CREATE2 address derivation, STATICCALL write
+protection, Istanbul/London env opcodes (this build's analog of the
+reference's tests/instructions/ suite: sar_test.py, create2_test.py,
+static_call_test.py, ...)."""
+
+import pytest
+
+from mythril_tpu.support.support_utils import sha3
+from tests.harness import (
+    ADDR,
+    CALLER,
+    asm,
+    committed_storage,
+    push,
+    run_concrete,
+)
+
+M = 2**256
+
+
+def _store_result(program: bytearray) -> bytearray:
+    """Append: SSTORE(0, top-of-stack); STOP."""
+    return program + push(0, 1) + asm("SSTORE", "STOP")
+
+
+# EIP-145 reference vectors (value, shift, expected)
+SHL_VECTORS = [
+    (1, 0, 1),
+    (1, 1, 2),
+    (1, 255, 1 << 255),
+    (1, 256, 0),
+    (M - 1, 1, M - 2),
+    (0, 1, 0),
+]
+SHR_VECTORS = [
+    (1, 0, 1),
+    (1, 1, 0),
+    (1 << 255, 1, 1 << 254),
+    (1 << 255, 255, 1),
+    (1 << 255, 256, 0),
+    (M - 1, 8, (M - 1) >> 8),
+]
+SAR_VECTORS = [
+    (1, 0, 1),
+    (1, 1, 0),
+    (1 << 255, 1, (0b11 << 254)),
+    (1 << 255, 255, M - 1),
+    (1 << 255, 256, M - 1),
+    (M - 1, 1, M - 1),
+    (M - 16, 4, M - 1),
+    (127, 4, 7),
+]
+
+
+@pytest.mark.parametrize("value,shift,expected", SHL_VECTORS)
+def test_shl(value, shift, expected):
+    program = push(value) + push(shift, 2) + asm("SHL")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == expected
+
+
+@pytest.mark.parametrize("value,shift,expected", SHR_VECTORS)
+def test_shr(value, shift, expected):
+    program = push(value) + push(shift, 2) + asm("SHR")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == expected
+
+
+@pytest.mark.parametrize("value,shift,expected", SAR_VECTORS)
+def test_sar(value, shift, expected):
+    program = push(value) + push(shift, 2) + asm("SAR")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == expected
+
+
+def test_signextend():
+    # SIGNEXTEND(0, 0xFF) = -1; SIGNEXTEND(0, 0x7F) = 0x7F
+    program = push(0xFF) + push(0, 1) + asm("SIGNEXTEND")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == M - 1
+    program = push(0x7F) + push(0, 1) + asm("SIGNEXTEND")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == 0x7F
+
+
+def test_byte_opcode():
+    # BYTE(31, x) = lowest byte; BYTE(0, x) = highest byte
+    x = 0xAABB00000000000000000000000000000000000000000000000000000000CCDD
+    program = push(x) + push(31, 1) + asm("BYTE")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == 0xDD
+    program = push(x) + push(0, 1) + asm("BYTE")
+    _, laser = run_concrete(bytes(_store_result(program)))
+    assert committed_storage(laser, 0) == 0xAA
+
+
+# -- CREATE / CREATE2 address derivation ------------------------------------
+
+# init code returning a 1-byte runtime code (STOP): PUSH1 1 PUSH1 0 RETURN
+# (an init returning EMPTY code counts as a failed creation, matching the
+# reference's ContractCreationTransaction.end which raises without
+# committing when return_data is empty)
+EMPTY_INIT = bytes([0x60, 0x01, 0x60, 0x00, 0xF3])
+
+
+def _mstore_bytes(data: bytes, offset: int = 0) -> bytearray:
+    """Store `data` (<=32 bytes) left-aligned at memory[offset]."""
+    word = int.from_bytes(data.ljust(32, b"\x00"), "big")
+    return push(word) + push(offset, 1) + asm("MSTORE")
+
+
+def test_create2_address_derivation():
+    """EIP-1014: addr = keccak256(0xff ++ sender ++ salt ++
+    keccak256(init))[12:]."""
+    salt = 0x42
+    program = (
+        _mstore_bytes(EMPTY_INIT)
+        + push(salt)                      # salt
+        + push(len(EMPTY_INIT), 1)        # length
+        + push(0, 1)                      # offset
+        + push(0, 1)                      # value
+        + asm("CREATE2")
+    )
+    _, laser = run_concrete(bytes(_store_result(program)))
+    expected = int.from_bytes(
+        sha3(
+            b"\xff"
+            + ADDR.to_bytes(20, "big")
+            + salt.to_bytes(32, "big")
+            + sha3(EMPTY_INIT)
+        )[12:],
+        "big",
+    )
+    assert committed_storage(laser, 0) == expected
+
+
+def test_create_address_derivation():
+    """CREATE: addr = keccak256(rlp([sender, nonce]))[12:]."""
+    program = (
+        _mstore_bytes(EMPTY_INIT)
+        + push(len(EMPTY_INIT), 1)
+        + push(0, 1)
+        + push(0, 1)
+        + asm("CREATE")
+    )
+    _, laser = run_concrete(bytes(_store_result(program)))
+    created = committed_storage(laser, 0)
+    # rlp([20-byte addr, nonce 0]) = 0xd6 0x94 <addr> 0x80
+    rlp = b"\xd6\x94" + ADDR.to_bytes(20, "big") + b"\x80"
+    expected = int.from_bytes(sha3(rlp)[12:], "big")
+    assert created == expected
+
+
+# -- STATICCALL write protection --------------------------------------------
+
+def _staticcall_retval_forced_to_one(laser) -> bool:
+    """Whether the committed constraints force storage[0] (the stored
+    retval; like the reference, call success flags are fresh symbols
+    constrained to 1 on success and unconstrained on failure) to 1."""
+    from mythril_tpu.smt import Solver, symbol_factory, unsat
+
+    ws = laser.open_states[0]
+    from tests.harness import ADDR as _a
+
+    val = ws.accounts[_a].storage[symbol_factory.BitVecVal(0, 256)]
+    s = Solver()
+    s.set_timeout(10000)
+    for c in ws.constraints:
+        s.add(c)
+    s.add(val != symbol_factory.BitVecVal(1, 256))
+    return s.check() == unsat
+
+
+def test_staticcall_write_protection():
+    """An SSTORE inside a STATICCALL frame must fail the sub-call and
+    not commit storage (reference static_call_test.py /
+    WriteProtection)."""
+    callee_addr = 0xBEEF
+    callee_code = bytes(push(1, 1) + push(7, 1) + asm("SSTORE", "STOP"))
+    program = (
+        push(0, 1)        # retSize
+        + push(0, 1)      # retOffset
+        + push(0, 1)      # argSize
+        + push(0, 1)      # argOffset
+        + push(callee_addr)
+        + push(300000, 3)  # gas
+        + asm("STATICCALL")
+    )
+    _, laser = run_concrete(
+        bytes(_store_result(program)),
+        extra_accounts=[(callee_addr, callee_code, 0)],
+    )
+    # the write never lands in the callee's committed storage
+    callee_storage = laser.open_states[0].accounts[callee_addr].storage
+    from mythril_tpu.smt import symbol_factory
+
+    val = callee_storage[symbol_factory.BitVecVal(7, 256)]
+    val = val if isinstance(val, int) else val.value
+    assert val == 0
+    # and the success flag is NOT forced to 1
+    assert not _staticcall_retval_forced_to_one(laser)
+
+
+def test_staticcall_read_is_allowed():
+    """A pure callee that RETURNs data runs fine under STATICCALL: the
+    success flag is constrained to 1 (a STOP callee leaves the flag
+    unconstrained — reference post_handler only constrains when return
+    data exists)."""
+    callee_addr = 0xBEEF
+    # mstore(0, 42); return(0, 32)
+    callee_code = bytes(
+        push(42, 1) + push(0, 1) + asm("MSTORE")
+        + push(32, 1) + push(0, 1) + asm("RETURN")
+    )
+    program = (
+        push(32, 1) + push(0, 1) + push(0, 1) + push(0, 1)
+        + push(callee_addr) + push(300000, 3)
+        + asm("STATICCALL")
+    )
+    _, laser = run_concrete(
+        bytes(_store_result(program)),
+        extra_accounts=[(callee_addr, callee_code, 0)],
+    )
+    assert _staticcall_retval_forced_to_one(laser)
+
+
+# -- env opcodes -------------------------------------------------------------
+
+def test_selfbalance():
+    program = asm("SELFBALANCE")
+    _, laser = run_concrete(bytes(_store_result(bytearray(program))))
+    assert committed_storage(laser, 0) == 10**18
+
+
+def test_address_caller_origin():
+    program = asm("ADDRESS")
+    _, laser = run_concrete(bytes(_store_result(bytearray(program))))
+    assert committed_storage(laser, 0) == ADDR
+    program = asm("CALLER")
+    _, laser = run_concrete(bytes(_store_result(bytearray(program))))
+    assert committed_storage(laser, 0) == CALLER
+
+
+def test_callvalue_and_balance_transfer():
+    program = asm("CALLVALUE")
+    _, laser = run_concrete(bytes(_store_result(bytearray(program))),
+                            value=555)
+    assert committed_storage(laser, 0) == 555

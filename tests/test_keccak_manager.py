@@ -1,0 +1,80 @@
+"""Keccak function manager semantics (this build's analog of the
+reference's tests/laser/keccak_tests.py): hash equality/inequality must
+be sat/unsat as expected under the manager's axioms."""
+
+import pytest
+
+from mythril_tpu.laser.function_managers.keccak_function_manager import (
+    keccak_function_manager,
+)
+from mythril_tpu.smt import And, Solver, sat, symbol_factory, unsat
+
+
+def _solver_with_axioms(*constraints):
+    s = Solver()
+    s.set_timeout(30000)
+    for c in constraints:
+        s.add(c)
+    s.add(keccak_function_manager.create_conditions())
+    return s
+
+
+@pytest.fixture(autouse=True)
+def reset_manager():
+    keccak_function_manager.reset()
+    yield
+    keccak_function_manager.reset()
+
+
+def test_equal_inputs_equal_hashes():
+    a = symbol_factory.BitVecSym("ka", 256)
+    b = symbol_factory.BitVecSym("kb", 256)
+    ha = keccak_function_manager.create_keccak(a)
+    hb = keccak_function_manager.create_keccak(b)
+    s = _solver_with_axioms(a == b, ha != hb)
+    assert s.check() == unsat
+
+
+def test_different_inputs_can_hash_differently():
+    a = symbol_factory.BitVecSym("kc", 256)
+    b = symbol_factory.BitVecSym("kd", 256)
+    ha = keccak_function_manager.create_keccak(a)
+    hb = keccak_function_manager.create_keccak(b)
+    s = _solver_with_axioms(a != b, ha != hb)
+    assert s.check() == sat
+
+
+def test_hash_equality_implies_input_equality():
+    """The manager axiomatizes an inverse function, so same-width hash
+    collisions are modeled as impossible (reference keccak manager's
+    inverse axiom)."""
+    a = symbol_factory.BitVecSym("ke", 256)
+    b = symbol_factory.BitVecSym("kf", 256)
+    ha = keccak_function_manager.create_keccak(a)
+    hb = keccak_function_manager.create_keccak(b)
+    s = _solver_with_axioms(ha == hb, a != b)
+    assert s.check() == unsat
+
+
+def test_concrete_input_hashes_concretely():
+    val = symbol_factory.BitVecVal(42, 256)
+    h = keccak_function_manager.create_keccak(val)
+    from mythril_tpu.support.support_utils import sha3
+
+    expected = int.from_bytes(sha3((42).to_bytes(32, "big")), "big")
+    s = _solver_with_axioms()
+    assert s.check() == sat
+    got = s.model().eval(h, True)
+    assert got.value == expected
+
+
+def test_hashes_land_in_disjoint_intervals():
+    """Hashes of different widths are confined to disjoint output
+    intervals (the PART split of 2^256), so cross-width equality is
+    unsat."""
+    a = symbol_factory.BitVecSym("kg", 256)
+    b = symbol_factory.BitVecSym("kh", 512)
+    ha = keccak_function_manager.create_keccak(a)
+    hb = keccak_function_manager.create_keccak(b)
+    s = _solver_with_axioms(ha == hb)
+    assert s.check() == unsat
