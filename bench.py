@@ -39,7 +39,7 @@ def build_contract():
     loop = len(code)
     code += bytes([op["JUMPDEST"], op["DUP2"], op["ISZERO"]])
     code += push(0, 2) + bytes([op["JUMPI"]])
-    patch = len(code) - 3
+    patch = len(code) - 4  # the PUSH2 opcode; +1..+3 are its operands
     # acc = acc*3 + n; n -= 1
     code += push(3) + bytes([op["MUL"], op["DUP2"], op["ADD"]])
     code += bytes([op["SWAP1"]]) + push(1) + bytes([op["SWAP1"], op["SUB"], op["SWAP1"]])
@@ -74,7 +74,7 @@ def bench_device(code, n_lanes=4096, repeats=3):
             cd_size=stepper.jnp.full((n_lanes,), 32, stepper.jnp.int32),
         )
 
-    max_steps = 700  # 97 iterations x ~6 instrs + prologue, with margin
+    max_steps = 1800  # up to 96 iterations x 16 instrs + prologue + margin
     run = jax.jit(stepper.run, static_argnums=(2,))
 
     # warm-up / compile

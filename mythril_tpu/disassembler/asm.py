@@ -77,8 +77,16 @@ def disassemble(bytecode) -> List[EvmInstruction]:
         length -= 43
 
     while address < length:
+        cur = bytecode[address]
+        if not isinstance(cur, int):
+            # symbolic byte (runtime code from a creation tx that wasn't
+            # fully concrete): undecodable -> INVALID, like the
+            # reference's KeyError path (asm.py:127-131)
+            instruction_list.append(EvmInstruction(address, "INVALID"))
+            address += 1
+            continue
         try:
-            op_code = ADDRESS_OPCODE_MAPPING[bytecode[address]]
+            op_code = ADDRESS_OPCODE_MAPPING[cur]
         except KeyError:
             instruction_list.append(EvmInstruction(address, "INVALID"))
             address += 1

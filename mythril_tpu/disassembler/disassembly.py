@@ -31,7 +31,19 @@ class Disassembly(object):
     def assign_bytecode(self, bytecode):
         self.bytecode = bytecode
         if isinstance(bytecode, tuple):
-            self.instruction_list = asm.disassemble(bytes(bytecode))
+            # runtime code returned by a creation tx: elements may be
+            # ints, concrete BitVec(8)s (memory stores Extracts of
+            # MSTOREd words), or genuinely symbolic bytes. Fold concrete
+            # values; map symbolic bytes to an out-of-range sentinel the
+            # linear sweep renders as INVALID (reference behavior:
+            # asm.disassemble KeyError -> INVALID).
+            from ..support.support_utils import fold_concrete_bytes
+
+            norm = fold_concrete_bytes(bytecode)
+            if all(isinstance(b, int) for b in norm):
+                self.instruction_list = asm.disassemble(bytes(norm))
+            else:
+                self.instruction_list = asm.disassemble(norm)
         else:
             self.instruction_list = asm.disassemble(bytecode)
         # open from default locations
@@ -49,7 +61,8 @@ class Disassembly(object):
             function_hash, jump_target, function_name = get_function_info(
                 index, self.instruction_list, signature_database
             )
-            self.func_hashes.append(function_hash)
+            if function_hash is not None:
+                self.func_hashes.append(function_hash)
             if jump_target is not None and function_name is not None:
                 self.function_name_to_address[function_name] = jump_target
                 self.address_to_function_name[jump_target] = function_name
@@ -67,6 +80,10 @@ def get_function_info(
     function_hash = instruction_list[index]["argument"]
     if isinstance(function_hash, (bytes, tuple)):
         function_hash = "0x" + bytes(function_hash).hex()
+    if not isinstance(function_hash, str):
+        # PUSH argument containing symbolic bytes (list slice from a
+        # partially-symbolic runtime code): not a selector entry
+        return None, None, None
     # normalize to 4-byte selector hex
     function_hash = "0x" + function_hash[2:].rjust(8, "0")
 

@@ -316,6 +316,30 @@ class Instruction:
     def exp_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         base, exponent = util.pop_bitvec(state), util.pop_bitvec(state)
+        if not base.symbolic and base.value is not None:
+            b = base.value
+            if b in (0, 1):
+                # 0**e = (e==0), 1**e = 1
+                zero = symbol_factory.BitVecVal(0, 256)
+                one = symbol_factory.BitVecVal(1, 256)
+                result = one if b == 1 else If(exponent == zero, one, zero)
+                state.stack.append(result)
+                return [global_state]
+            if b & (b - 1) == 0:
+                # (2^m)**e == 1 << (m*e): keeps the Solidity
+                # storage-packing idiom (256**k divisors) as shifts the
+                # solver handles cheaply instead of an opaque Power UF.
+                # Guard: for e >= 256 the true result is 0 (m >= 1) and
+                # m*e must not be allowed to wrap mod 2^256.
+                m = b.bit_length() - 1
+                shift = symbol_factory.BitVecVal(m, 256) * exponent
+                result = If(
+                    ULT(exponent, symbol_factory.BitVecVal(256, 256)),
+                    symbol_factory.BitVecVal(1, 256) << shift,
+                    symbol_factory.BitVecVal(0, 256),
+                )
+                state.stack.append(result)
+                return [global_state]
         exponentiation, constraint = (
             exponent_function_manager.create_condition(base, exponent)
         )
@@ -559,11 +583,18 @@ class Instruction:
     @StateTransition()
     def balance_(self, global_state: GlobalState) -> List[GlobalState]:
         address = util.pop_bitvec(global_state.mstate)
+        balance = None
         if address.value is not None:
-            balance = global_state.world_state.accounts_exist_or_load(
-                address.value, self.dynamic_loader
-            ).balance()
-        else:
+            try:
+                balance = global_state.world_state.accounts_exist_or_load(
+                    address.value, self.dynamic_loader
+                ).balance()
+            except ValueError:
+                # unknown account without on-chain loading (reference
+                # instructions.py:916-929 falls back to an If-chain over
+                # known accounts; the global balances array covers that)
+                balance = None
+        if balance is None:
             balance = global_state.world_state.balances[address]
         global_state.mstate.stack.append(balance)
         return [global_state]
@@ -1187,8 +1218,31 @@ class Instruction:
             )
         except ValueError:
             raise VmException("Invalid Push instruction")
-        if isinstance(push_value, (tuple, bytes)):
-            push_value = "0x" + bytes(push_value).hex()
+        if isinstance(push_value, (tuple, list, bytes)):
+            if all(isinstance(b, int) for b in push_value):
+                push_value = "0x" + bytes(push_value).hex()
+            else:
+                # partially-symbolic immediate (code created from a
+                # creation tx whose runtime bytes weren't all concrete):
+                # concatenate byte terms (reference
+                # instructions.py:292-313)
+                parts = [
+                    b if isinstance(b, BitVec)
+                    else symbol_factory.BitVecVal(b, 8)
+                    for b in push_value
+                ]
+                pad_bytes = length_of_value // 2 - len(parts)
+                if pad_bytes > 0:
+                    parts.append(symbol_factory.BitVecVal(0, 8 * pad_bytes))
+                new_value = Concat(parts) if len(parts) > 1 else parts[0]
+                if new_value.size() < 256:
+                    new_value = Concat(
+                        symbol_factory.BitVecVal(
+                            0, 256 - new_value.size()),
+                        new_value,
+                    )
+                global_state.mstate.stack.append(new_value)
+                return [global_state]
         push_value += "0" * max(
             length_of_value - (len(push_value) - 2), 0
         )
@@ -1244,17 +1298,10 @@ class Instruction:
 
         # memory bytes may be concrete BitVec(8) constants (MSTORE writes
         # Extracts of the stored word); fold them before the symbolic check
-        folded_code = []
-        symbolic_code = False
-        for b in callee_code:
-            if isinstance(b, int):
-                folded_code.append(b)
-            elif b.value is not None:
-                folded_code.append(b.value)
-            else:
-                symbolic_code = True
-                break
-        if symbolic_code:
+        from ..support.support_utils import fold_concrete_bytes
+
+        folded_code = fold_concrete_bytes(callee_code)
+        if not all(isinstance(b, int) for b in folded_code):
             log.debug("Symbolic creation code; treating result as symbolic")
             mstate.stack.append(
                 global_state.new_bitvec(
@@ -1446,6 +1493,11 @@ class Instruction:
     @StateTransition(increment_pc=False)
     def call_(self, global_state: GlobalState) -> List[GlobalState]:
         environment = global_state.environment
+        # capture the out-window BEFORE get_call_parameters pops the 7
+        # args: the ValueError path below must not touch the popped stack
+        # (reference instructions.py reads stack[-7:-5] up front)
+        out_offset_pre = global_state.mstate.stack[-6]
+        out_size_pre = global_state.mstate.stack[-7]
         try:
             (
                 callee_address,
@@ -1484,13 +1536,10 @@ class Instruction:
             log.debug(
                 "Could not determine required parameters for call: %s", e
             )
+            # get_call_parameters pops its 7 args before it can raise
             self._write_symbolic_returndata(
-                global_state,
-                global_state.mstate.stack[-6],
-                global_state.mstate.stack[-7],
+                global_state, out_offset_pre, out_size_pre
             )
-            for _ in range(7):
-                global_state.mstate.stack.pop()
             util.insert_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
@@ -1529,6 +1578,8 @@ class Instruction:
     @StateTransition(increment_pc=False)
     def callcode_(self, global_state: GlobalState) -> List[GlobalState]:
         environment = global_state.environment
+        out_offset_pre = global_state.mstate.stack[-6]
+        out_size_pre = global_state.mstate.stack[-7]
         try:
             (
                 callee_address,
@@ -1560,13 +1611,10 @@ class Instruction:
             log.debug(
                 "Could not determine required parameters for call: %s", e
             )
+            # get_call_parameters pops its 7 args before it can raise
             self._write_symbolic_returndata(
-                global_state,
-                global_state.mstate.stack[-6],
-                global_state.mstate.stack[-7],
+                global_state, out_offset_pre, out_size_pre
             )
-            for _ in range(7):
-                global_state.mstate.stack.pop()
             util.insert_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
@@ -1606,6 +1654,8 @@ class Instruction:
     @StateTransition(increment_pc=False)
     def delegatecall_(self, global_state: GlobalState) -> List[GlobalState]:
         environment = global_state.environment
+        out_offset_pre = global_state.mstate.stack[-5]
+        out_size_pre = global_state.mstate.stack[-6]
         try:
             (
                 callee_address,
@@ -1632,13 +1682,10 @@ class Instruction:
             log.debug(
                 "Could not determine required parameters for call: %s", e
             )
+            # get_call_parameters pops its 6 args before it can raise
             self._write_symbolic_returndata(
-                global_state,
-                global_state.mstate.stack[-5],
-                global_state.mstate.stack[-6],
+                global_state, out_offset_pre, out_size_pre
             )
-            for _ in range(6):
-                global_state.mstate.stack.pop()
             util.insert_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
@@ -1681,6 +1728,8 @@ class Instruction:
     @StateTransition(increment_pc=False)
     def staticcall_(self, global_state: GlobalState) -> List[GlobalState]:
         environment = global_state.environment
+        out_offset_pre = global_state.mstate.stack[-5]
+        out_size_pre = global_state.mstate.stack[-6]
         try:
             (
                 callee_address,
@@ -1707,13 +1756,10 @@ class Instruction:
             log.debug(
                 "Could not determine required parameters for call: %s", e
             )
+            # get_call_parameters pops its 6 args before it can raise
             self._write_symbolic_returndata(
-                global_state,
-                global_state.mstate.stack[-5],
-                global_state.mstate.stack[-6],
+                global_state, out_offset_pre, out_size_pre
             )
-            for _ in range(6):
-                global_state.mstate.stack.pop()
             util.insert_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]

@@ -109,9 +109,18 @@ class WorldState:
 
         if addr_bitvec.value in self.accounts:
             return self.accounts[addr_bitvec.value]
-        if dynamic_loader is not None and dynamic_loader.active and isinstance(
-            addr, int
-        ):
+        # Unknown account without on-chain loading: RAISE rather than
+        # auto-create an empty account. Callers (extcodesize/extcodehash/
+        # extcodecopy) then push a fresh symbol, so both sides of
+        # Solidity's `extcodesize(target) > 0` interface-call guard stay
+        # explorable (reference world_state.py:114-117 — auto-creating
+        # concrete-empty code here concretely falsifies the guard and
+        # hides everything behind it, e.g. asserts after interface calls).
+        if dynamic_loader is None:
+            raise ValueError("dynamic_loader is None")
+        if dynamic_loader.active is False:
+            raise ValueError("Dynamic loader is deactivated. Use a symbol.")
+        if isinstance(addr, int):
             try:
                 balance = dynamic_loader.read_balance(
                     "{0:#0{1}x}".format(addr, 42)
@@ -125,7 +134,14 @@ class WorldState:
                 )
             except ValueError:
                 log.debug("dynamic load failed for %s", addr)
-        return self[addr_bitvec]
+        try:
+            code = dynamic_loader.dynld(addr)
+        except ValueError:
+            code = None
+        return self.create_account(
+            address=addr_bitvec.value, dynamic_loader=dynamic_loader,
+            code=code,
+        )
 
     def create_account(
         self,

@@ -59,6 +59,12 @@ def get_lib() -> ctypes.CDLL:
             ctypes.c_int32,
         ]
         lib.mtpu_sat_add_clause.restype = ctypes.c_int32
+        lib.mtpu_sat_add_clauses.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mtpu_sat_add_clauses.restype = ctypes.c_int32
         lib.mtpu_sat_solve.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int32),
@@ -95,12 +101,22 @@ class SatSolver:
     """Thin OO wrapper over the native CDCL core.
 
     Literals are DIMACS-style signed ints over 1-based variables.
+
+    Clauses are buffered host-side and shipped through one bulk FFI
+    crossing at solve time: the bit-blaster emits hundreds of thousands
+    of Tseitin clauses, and a per-clause ctypes call dominated solver
+    wall-clock. Variable allocation is likewise a local counter — the
+    native core extends its tables lazily on first use of a variable.
     """
 
     def __init__(self) -> None:
+        import array as _array
+
         self._lib = get_lib()
         self._h = self._lib.mtpu_sat_new()
         self.nvars = 0
+        self._buf = _array.array("i")
+        self._latched_unsat = False
 
     def __del__(self) -> None:
         try:
@@ -111,20 +127,48 @@ class SatSolver:
             pass
 
     def new_var(self) -> int:
+        # no FFI: the native core creates variables lazily when a clause
+        # or assumption first mentions them
         self.nvars += 1
-        self._lib.mtpu_sat_new_var(self._h)
         return self.nvars
 
     def add_clause(self, lits) -> bool:
-        arr = (ctypes.c_int32 * len(lits))(*lits)
         for l in lits:
             v = abs(l)
             if v > self.nvars:
                 self.nvars = v
-        return bool(self._lib.mtpu_sat_add_clause(self._h, arr, len(lits)))
+        self._buf.extend(lits)
+        self._buf.append(0)
+        return True
+
+    def emit_flat(self, lits_with_terminators) -> None:
+        """Fast path for trusted emitters (the bit-blaster): append a
+        pre-terminated clause stream whose variables all came from
+        new_var() (so the nvars scan is unnecessary)."""
+        self._buf.extend(lits_with_terminators)
+
+    def flush(self) -> bool:
+        """Ship buffered clauses to the native core in one FFI crossing.
+        Returns False if the formula became trivially UNSAT."""
+        if self._latched_unsat:
+            return False
+        n = len(self._buf)
+        if n == 0:
+            return True
+        addr, _ = self._buf.buffer_info()
+        r = self._lib.mtpu_sat_add_clauses(
+            self._h, ctypes.cast(addr, ctypes.POINTER(ctypes.c_int32)), n
+        )
+        del self._buf[:]
+        if r < 0:
+            self._latched_unsat = True
+            return False
+        return True
 
     def solve(self, assumptions=(), timeout: float = 0.0, conflicts: int = 0):
         """Returns True (sat), False (unsat), or None (budget exhausted)."""
+        if not self.flush():
+            return False
         arr = (ctypes.c_int32 * len(assumptions))(*assumptions)
         r = self._lib.mtpu_sat_solve(
             self._h, arr, len(assumptions), timeout, conflicts

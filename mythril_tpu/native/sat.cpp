@@ -537,6 +537,36 @@ int32_t mtpu_sat_add_clause(void* sp, const int32_t* lits, int32_t n) {
   }
   return s->add_clause(internal.data(), n) ? 1 : 0;
 }
+// Bulk clause stream: literals with 0 terminating each clause
+// (DIMACS body layout). One FFI crossing for an arbitrary number of
+// clauses — the per-call ctypes overhead dominates when the bit-blaster
+// emits hundreds of thousands of Tseitin clauses.
+// Returns the number of clauses added, or -1 on immediate UNSAT.
+int32_t mtpu_sat_add_clauses(void* sp, const int32_t* stream, int32_t n) {
+  Solver* s = (Solver*)sp;
+  std::vector<Lit> internal;
+  internal.reserve(8);
+  int32_t added = 0;
+  for (int i = 0; i < n; ++i) {
+    int32_t l = stream[i];
+    if (l == 0) {
+      if (!s->add_clause(internal.data(), (int32_t)internal.size()))
+        return -1;
+      ++added;
+      internal.clear();
+      continue;
+    }
+    Var v = (l > 0 ? l : -l) - 1;
+    while (v >= (int32_t)s->assign.size()) s->new_var();
+    internal.push_back(mklit(v, l < 0));
+  }
+  if (!internal.empty()) {
+    if (!s->add_clause(internal.data(), (int32_t)internal.size()))
+      return -1;
+    ++added;
+  }
+  return added;
+}
 int32_t mtpu_sat_solve(void* sp, const int32_t* assumps, int32_t n,
                        double timeout_s, int64_t conflict_budget) {
   Solver* s = (Solver*)sp;

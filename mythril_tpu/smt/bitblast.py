@@ -31,6 +31,11 @@ class Blaster:
         self.F = -self.T
         self._bv: Dict[int, List[int]] = {}
         self._bool: Dict[int, int] = {}
+        # structural gate caches: repeated subterms (carry chains,
+        # comparison ladders) re-request identical gates constantly
+        self._and_cache: Dict[tuple, int] = {}
+        self._xor_cache: Dict[tuple, int] = {}
+        self._ite_cache: Dict[tuple, int] = {}
 
     # -- gate layer ---------------------------------------------------------
 
@@ -57,10 +62,13 @@ class Blaster:
             return a
         if a == -b:
             return self.F
+        key = (a, b) if a < b else (b, a)
+        v = self._and_cache.get(key)
+        if v is not None:
+            return v
         v = self.new_lit()
-        self.sat.add_clause([-v, a])
-        self.sat.add_clause([-v, b])
-        self.sat.add_clause([v, -a, -b])
+        self.sat.emit_flat((-v, a, 0, -v, b, 0, v, -a, -b, 0))
+        self._and_cache[key] = v
         return v
 
     def g_or(self, a, b):
@@ -79,12 +87,21 @@ class Blaster:
             return self.F
         if a == -b:
             return self.T
-        v = self.new_lit()
-        self.sat.add_clause([-v, a, b])
-        self.sat.add_clause([-v, -a, -b])
-        self.sat.add_clause([v, a, -b])
-        self.sat.add_clause([v, -a, b])
-        return v
+        # canonicalize under XOR symmetries: xor(a,b)=xor(b,a) and
+        # xor(-a,b) = -xor(a,b)
+        neg = (a < 0) ^ (b < 0)
+        a_c, b_c = abs(a), abs(b)
+        key = (a_c, b_c) if a_c < b_c else (b_c, a_c)
+        v = self._xor_cache.get(key)
+        if v is None:
+            a_p, b_p = key
+            v = self.new_lit()
+            self.sat.emit_flat(
+                (-v, a_p, b_p, 0, -v, -a_p, -b_p, 0,
+                 v, a_p, -b_p, 0, v, -a_p, b_p, 0)
+            )
+            self._xor_cache[key] = v
+        return -v if neg else v
 
     def g_ite(self, c, a, b):
         if self.is_true(c):
@@ -97,11 +114,15 @@ class Blaster:
             return c
         if self.is_false(a) and self.is_true(b):
             return -c
+        key = (c, a, b)
+        v = self._ite_cache.get(key)
+        if v is not None:
+            return v
         v = self.new_lit()
-        self.sat.add_clause([-v, -c, a])
-        self.sat.add_clause([v, -c, -a])
-        self.sat.add_clause([-v, c, b])
-        self.sat.add_clause([v, c, -b])
+        self.sat.emit_flat(
+            (-v, -c, a, 0, v, -c, -a, 0, -v, c, b, 0, v, c, -b, 0)
+        )
+        self._ite_cache[key] = v
         return v
 
     def g_and_many(self, lits):

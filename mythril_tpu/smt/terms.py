@@ -224,6 +224,14 @@ def mk_mul(a: Term, b: Term) -> Term:
     return _intern(MUL, (a, b), width=a.width)
 
 
+def _is_shl_of_one(t: Term) -> bool:
+    """Matches shl(1, x) — the shape EXP(2^m, e) lowers to. Divisions by
+    such terms rewrite to shifts/masks, keeping the Solidity
+    storage-packing idiom (value / 256**k % 2**n) out of the O(w^2)
+    divider circuit."""
+    return t.op == SHL and is_const(t.args[0]) and t.args[0].val == 1
+
+
 def mk_udiv(a: Term, b: Term) -> Term:
     assert a.width == b.width
     if is_const(b):
@@ -233,6 +241,25 @@ def mk_udiv(a: Term, b: Term) -> Term:
             return bv_const(a.val // b.val, a.width)
         if b.val == 1:
             return a
+        if b.val & (b.val - 1) == 0:  # 2^k: shift instead of divide
+            return mk_lshr(
+                a, bv_const(b.val.bit_length() - 1, a.width))
+    if _is_shl_of_one(b):
+        # a / (1 << x) == a >> x, except the SMT-LIB division-by-zero
+        # case (x >= width makes the divisor 0 -> all-ones)
+        return mk_ite(
+            mk_eq(b, bv_const(0, b.width)),
+            bv_const(_mask(a.width), a.width),
+            mk_lshr(a, b.args[1]),
+        )
+    if b.op == ITE and all(
+        is_const(arm) or _is_shl_of_one(arm) for arm in b.args[1:]
+    ):
+        # lift the divide through a cheap-armed ITE so each side takes
+        # the shift/constant rewrite above
+        return mk_ite(
+            b.args[0], mk_udiv(a, b.args[1]), mk_udiv(a, b.args[2])
+        )
     return _intern(UDIV, (a, b), width=a.width)
 
 
@@ -245,6 +272,19 @@ def mk_urem(a: Term, b: Term) -> Term:
             return bv_const(a.val % b.val, a.width)
         if b.val == 1:
             return bv_const(0, a.width)
+        if b.val & (b.val - 1) == 0:  # 2^k: mask instead of modulo
+            return mk_and(a, bv_const(b.val - 1, a.width))
+    if _is_shl_of_one(b):
+        # a % (1 << x) == a & ((1 << x) - 1); when the shift overflows
+        # to 0 the mask becomes all-ones and a & ones == a, which is
+        # exactly the SMT-LIB x % 0 = x case
+        return mk_and(a, mk_sub(b, bv_const(1, b.width)))
+    if b.op == ITE and all(
+        is_const(arm) or _is_shl_of_one(arm) for arm in b.args[1:]
+    ):
+        return mk_ite(
+            b.args[0], mk_urem(a, b.args[1]), mk_urem(a, b.args[2])
+        )
     return _intern(UREM, (a, b), width=a.width)
 
 

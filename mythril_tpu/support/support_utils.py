@@ -75,6 +75,23 @@ class ModelCache:
         self.model_cache.put(model, weight)
 
 
+def fold_concrete_bytes(seq) -> list:
+    """Normalize a byte sequence that may mix ints, concrete BitVec(8)s
+    (memory stores Extracts of MSTOREd words) and genuinely symbolic
+    byte terms: ints stay, concrete BitVecs fold to their value,
+    symbolic terms pass through. Callers check `all(isinstance(b, int))`
+    to decide between the concrete and symbolic paths."""
+    out = []
+    for b in seq:
+        if isinstance(b, int):
+            out.append(b)
+        elif getattr(b, "value", None) is not None:
+            out.append(b.value)
+        else:
+            out.append(b)
+    return out
+
+
 def get_code_hash(code) -> str:
     """Keccak hash of hex bytecode string (reference support_utils.py:71-88)."""
     from ..native import keccak256
@@ -87,6 +104,11 @@ def get_code_hash(code) -> str:
         except ValueError:
             log.debug("invalid code hex: %s", code[:40])
             return ""
+    code = fold_concrete_bytes(code)
+    if not all(isinstance(b, int) for b in code):
+        # partially-symbolic runtime code: identity-hash the structure
+        # (reference support_utils.py:80-82 falls back to hash(code))
+        return str(hash(tuple(str(b) for b in code)))
     return "0x" + keccak256(bytes(code)).hex()
 
 

@@ -1,0 +1,75 @@
+"""CLI black-box tests (this build's analog of the reference's
+tests/cmd_line_test.py:5-66): run `python -m mythril_tpu ...` as a
+subprocess and grep stdout — disassembly output, SWC id presence in
+analyze output, failure JSON shape, exit codes."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SUICIDE_O = Path(
+    "/root/reference/tests/testdata/inputs/suicide.sol.o")
+
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+
+
+def run_myth(*argv, timeout=300):
+    proc = subprocess.run(
+        [sys.executable, "-m", "mythril_tpu", *argv],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=str(REPO),
+    )
+    return proc
+
+
+def test_version():
+    proc = run_myth("version")
+    assert proc.returncode == 0
+    assert "version" in proc.stdout.lower()
+
+
+def test_version_json():
+    proc = run_myth("version", "-o", "json")
+    assert json.loads(proc.stdout)["version_str"]
+
+
+def test_list_detectors():
+    proc = run_myth("list-detectors")
+    assert proc.returncode == 0
+    assert "AccidentallyKillable" in proc.stdout
+    assert "EtherThief" in proc.stdout
+
+
+def test_function_to_hash():
+    proc = run_myth("function-to-hash", "transfer(address,uint256)")
+    assert proc.stdout.strip() == "0xa9059cbb"
+
+
+def test_disassemble_bytecode():
+    proc = run_myth("d", "-c", "0x6001600101")
+    assert proc.returncode == 0
+    assert "PUSH1 0x01" in proc.stdout
+    assert "ADD" in proc.stdout
+
+
+def test_analyze_invalid_input_fails_cleanly():
+    proc = run_myth("analyze", "-o", "json", "--no-onchain-data")
+    data = json.loads(proc.stdout)
+    assert data["success"] is False
+    assert proc.returncode == 1
+
+
+@pytest.mark.skipif(not SUICIDE_O.exists(), reason="fixture not present")
+def test_analyze_finds_swc_106():
+    proc = run_myth(
+        "analyze", "-f", str(SUICIDE_O), "--bin-runtime", "-t", "1",
+        "-m", "AccidentallyKillable", "--no-onchain-data",
+    )
+    assert proc.returncode == 1  # issues found
+    assert "SWC ID: 106" in proc.stdout
+    assert "Transaction Sequence:" in proc.stdout
