@@ -419,8 +419,9 @@ def divmod_u(a, b):
         quot = quot | (inc[..., None] * limb_onehot)
         return quot, rem
 
-    quot0 = zeros(a.shape[:-1])
-    rem0 = zeros(a.shape[:-1])
+    # zeros_like keeps the carry varying over shard_map manual axes
+    quot0 = jnp.zeros_like(a)
+    rem0 = jnp.zeros_like(a)
     quot, rem = lax.fori_loop(
         jnp.int32(0), jnp.int32(WORD_BITS), body_dyn, (quot0, rem0)
     )
@@ -492,8 +493,10 @@ def _divmod_512_by_256(lo, hi, m):
         rem = jnp.where(ge[..., None], sub(rem, m), rem)
         return rem
 
-    rem = lax.fori_loop(jnp.int32(0), jnp.int32(512), body, zeros(lo.shape[:-1]))
-    return jnp.where(mz[..., None], zeros(lo.shape[:-1]), rem).astype(U32)
+    rem = lax.fori_loop(
+        jnp.int32(0), jnp.int32(512), body, jnp.zeros_like(lo)
+    )
+    return jnp.where(mz[..., None], jnp.zeros_like(lo), rem).astype(U32)
 
 
 def addmod(a, b, m):
@@ -531,7 +534,8 @@ def exp(base, exponent):
         acc = mul(acc, acc)
         return result, acc
 
-    one = from_u32(jnp.ones(base.shape[:-1], dtype=U32))
+    # derive from base so the carry stays varying under shard_map
+    one = jnp.zeros_like(base).at[..., 0].set(1)
     result, _ = lax.fori_loop(
         jnp.int32(0), jnp.int32(WORD_BITS), body, (one, base)
     )

@@ -5,10 +5,11 @@ launching many OS processes (tests/integration_tests/parallel_test.py:8-16
 in /root/reference). This module is the TPU-native replacement promised by
 SURVEY.md §2.10 (contract-level + distributed-backend rows): the lane batch
 (ops/stepper.LaneState) is sharded over a 1-D `lanes` axis of a
-jax.sharding.Mesh, the jitted stepper runs SPMD with XLA inserting no
-cross-chip traffic for the data-parallel step itself, and the few global
-decisions (how many lanes are live, when to rebalance/compact) ride ICI
-collectives (psum/all_gather) inside shard_map.
+jax.sharding.Mesh, the stepper loop runs per-device inside shard_map
+(no cross-chip traffic in the data-parallel stepping itself — the
+stepper's op-family gates reduce over the local shard only), and the few
+global decisions (how many lanes are live, when to rebalance/compact)
+ride ICI collectives (psum/all_gather) inside shard_map.
 
 Multi-host corpus sharding (one contract set per host over DCN) composes on
 top: each host builds its own mesh over local devices and runs an
@@ -68,18 +69,26 @@ def replicate_code(code: CompiledCode, mesh: Mesh) -> CompiledCode:
 def sharded_run(
     code: CompiledCode, state: LaneState, max_steps: int, mesh: Mesh
 ) -> LaneState:
-    """Run the stepper SPMD over the mesh. The per-step computation is
-    purely lane-parallel; XLA partitions it with zero collectives."""
-    sh = lane_sharding(mesh)
-    rep = replicated(mesh)
-    run = jax.jit(
-        stepper.run,
-        static_argnums=(2,),
-        in_shardings=(jax.tree_util.tree_map(lambda _: rep, code),
-                      jax.tree_util.tree_map(lambda _: sh, state)),
-        out_shardings=jax.tree_util.tree_map(lambda _: sh, state),
+    """Run the stepper SPMD over the mesh via shard_map: each device
+    executes its own while_loop over its lane shard with NO cross-chip
+    traffic — the stepper's op-family `lax.cond` gates reduce over the
+    LOCAL shard only, so a device whose lanes never touch memory this
+    step skips the memory block even if another device's lanes need it
+    (per-device divergence, strictly better than a global gate), and
+    each device's loop exits as soon as its own lanes halt."""
+    code_specs = jax.tree_util.tree_map(lambda _: P(), code)
+    state_specs = jax.tree_util.tree_map(lambda _: P(LANES_AXIS), state)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(code_specs, state_specs),
+        out_specs=state_specs,
     )
-    return run(code, state, max_steps)
+    def _run(code_local, state_local):
+        return stepper.run(code_local, state_local, max_steps)
+
+    return jax.jit(_run)(code, state)
 
 
 def live_lane_counts(state: LaneState, mesh: Mesh):
