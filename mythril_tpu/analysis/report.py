@@ -163,14 +163,17 @@ class Report:
         )
 
     def append_issue(self, issue: Issue) -> None:
-        """Deduplicate on (bytecode hash, description, address)."""
+        """Deduplicate on (contract, function, address, title) — the
+        function name must participate (reference report.py:273-281), or
+        distinct violations routed through a shared helper block (e.g.
+        solc 0.8's panic routine) collapse into one issue."""
         m = hashlib.md5()
         m.update(
             (
-                issue.bytecode_hash
-                + str(issue.description)
+                issue.contract
+                + issue.function
                 + str(issue.address)
-                + str(issue.swc_id)
+                + issue.title
             ).encode("utf-8")
         )
         issue.resolve_function_name()

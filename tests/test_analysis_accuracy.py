@@ -22,21 +22,10 @@ CASES = [
     ("flag_array.sol.o", "EtherThief", 1, 1, 0, 1,
      "0xab12585800000000000000000000000000000000000000000000000000000000"
      "000004d2"),
-    # The reference's CI expects 2 issues here. Both asserts route
-    # through solc 0.8's shared panic helper, so both violations REVERT
-    # at the same address with the same last-JUMP cache key and dedupe
-    # to one issue under the reference's own caching scheme as we
-    # implement it; additionally fail()'s assert(val==2) is semantically
-    # unreachable at transaction_count=1 (storage starts concrete 0).
-    # Tracked for a future round: reproduce the reference's exact
-    # last-jump bookkeeping on this fixture.
-    pytest.param(
-        "exceptions_0.8.0.sol.o", "Exceptions", 1, 2, 0, 1, None,
-        marks=pytest.mark.xfail(
-            reason="shared panic-helper jump dedupes to 1 issue "
-                   "(reference expects 2)", strict=False,
-        ),
-    ),
+    # both 0.8 asserts REVERT in the shared panic helper at the same
+    # address; they survive as 2 issues because report dedup keys on
+    # the function name (report.py append_issue)
+    ("exceptions_0.8.0.sol.o", "Exceptions", 1, 2, 0, 1, None),
     ("symbolic_exec_bytecode.sol.o", "AccidentallyKillable", 1, 1, 0, 0,
      None),
     ("extcall.sol.o", "Exceptions", 1, 1, 0, 0, None),
