@@ -47,14 +47,25 @@ class MythrilConfig:
         """Create/load config.ini; pick up a default RPC + infura id."""
         config = configparser.ConfigParser()
         if os.path.exists(self.config_path):
-            config.read(self.config_path)
+            try:
+                config.read(self.config_path)
+            except configparser.Error as e:
+                log.warning("corrupt config.ini ignored: %s", e)
         if "defaults" not in config:
             config["defaults"] = {
                 "dynamic_loading": "infura",
             }
             try:
-                with open(self.config_path, "w") as f:
-                    config.write(f)
+                from ..support.lock import LockFile
+
+                # temp-file + atomic rename: a concurrent or interrupted
+                # writer can never leave a half-written config.ini for
+                # readers (which run unlocked)
+                with LockFile(self.config_path + ".lock"):
+                    tmp = self.config_path + ".tmp"
+                    with open(tmp, "w") as f:
+                        config.write(f)
+                    os.replace(tmp, self.config_path)
             except OSError as e:
                 log.debug("could not write config: %s", e)
         defaults = config["defaults"]

@@ -290,11 +290,13 @@ def check(
 
 def _optimize_objectives(blaster, sat, minimize, maximize, subs, timeout_s,
                          t0):
-    """Greedy bitwise lexicographic optimization under assumptions.
+    """Lexicographic optimization by binary search on the objective value
+    (~log2(initial model value) solves per objective instead of one solve
+    per bit — the per-bit MSB probing dominated get_transaction_sequence
+    wall time with ~256 incremental solves per objective).
 
-    Invariant restored on every exit path: the SAT core holds a satisfying
-    assignment for the original constraints (a failed/aborted probe calls
-    cancel_until and would otherwise leave a garbage model behind)."""
+    Invariant restored on every exit path: the SAT core holds a
+    satisfying assignment for the original constraints."""
     fixed: List[int] = []
     objectives = [(obj, False) for obj in minimize] + [
         (obj, True) for obj in maximize
@@ -308,22 +310,75 @@ def _optimize_objectives(blaster, sat, minimize, maximize, subs, timeout_s,
             bits = blaster.bits(obj_sub)
         except NotImplementedError:
             continue  # objective contains arrays not present in constraints
-        for l in reversed(bits):  # MSB first
-            want = l if maximizing else -l
-            if blaster.is_true(l) or blaster.is_false(l):
-                continue
+
+        def read_val():
+            v = 0
+            for i, l in enumerate(bits):
+                if blaster.is_true(l):
+                    v |= 1 << i
+                elif blaster.is_false(l):
+                    pass
+                elif sat.value(abs(l)) != (l < 0):
+                    v |= 1 << i
+            return v
+
+        def bound_lit(limit, upper):
+            """Literal for obj <= limit (upper) / obj >= limit."""
+            const = blaster.const_bits(limit, len(bits))
+            if upper:
+                return -blaster.ult_vec(const, bits)  # !(limit < obj)
+            return -blaster.ult_vec(bits, const)  # !(obj < limit)
+
+        # current model gives the starting bound
+        remaining = timeout_s - (time.monotonic() - t0)
+        if remaining <= 0:
+            break
+        r = sat.solve(assumptions=fixed, timeout=remaining,
+                      conflicts=20000)
+        if r is not True:
+            break
+        best = read_val()
+        lo, hi = 0, best
+        full = (1 << len(bits)) - 1
+        if maximizing:
+            lo, hi = best, full
+        while lo < hi:
             remaining = timeout_s - (time.monotonic() - t0)
             if remaining <= 0:
                 break
+            mid = (lo + hi) // 2  # probe the lower (upper) half
+            want = bound_lit(mid, upper=not maximizing)
             r = sat.solve(
-                assumptions=fixed + [want], timeout=remaining, conflicts=20000
+                assumptions=fixed + [want], timeout=remaining,
+                conflicts=20000,
             )
             if r is True:
-                fixed.append(want)
+                got = read_val()
+                if maximizing:
+                    lo = max(got, mid + 1)
+                    best = max(best, got)
+                else:
+                    hi = min(got, mid)
+                    best = min(best, got)
             elif r is False:
-                fixed.append(-want)
+                if maximizing:
+                    hi = mid
+                else:
+                    lo = mid + 1
             else:
                 break
+        # pin the found optimum for subsequent objectives
+        eq_lits = []
+        ok = True
+        for i, l in enumerate(bits):
+            want_bit = (best >> i) & 1
+            if blaster.is_true(l) or blaster.is_false(l):
+                if int(blaster.is_true(l)) != want_bit:
+                    ok = False  # constant bits contradict (stale best)
+                continue
+            eq_lits.append(l if want_bit else -l)
+        if ok:
+            fixed.extend(eq_lits)
     # restore a model consistent with whatever got fixed; fall back to the
     # unconstrained problem if even that probe is over budget
     r = sat.solve(

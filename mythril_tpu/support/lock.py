@@ -1,0 +1,37 @@
+"""File locking for shared ~/.mythril_tpu state (capability parity:
+mythril/support/lock.py — serializes config.ini / signature-DB access
+across the many-process usage pattern the reference's parallel_test
+exercises)."""
+
+import fcntl
+import os
+
+
+class LockFile:
+    """Advisory exclusive lock; usable as a context manager."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fd = None
+
+    def acquire(self) -> None:
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except OSError:
+            os.close(fd)  # flock unsupported (e.g. some NFS): no fd leak
+            raise
+        self._fd = fd
+
+    def release(self) -> None:
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> "LockFile":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
