@@ -15,6 +15,7 @@ from ..laser.execution_info import ExecutionInfo
 from ..smt import BitVec
 from ..support.signatures import SignatureDB
 from ..support.source_support import Source
+from ..support.start_time import StartTime
 from .swc_data import SWC_TO_TITLE
 
 log = logging.getLogger(__name__)
@@ -52,7 +53,11 @@ class Issue:
         self.code = None
         self.lineno = None
         self.source_mapping = None
-        self.discovery_time = time.time()
+        # elapsed since analysis start, like the reference (report.py:69);
+        # clamped: the singleton may initialize lazily in this expression
+        self.discovery_time = max(
+            0.0, time.time() - StartTime().global_start_time
+        )
         self.bytecode_hash = get_code_hash(bytecode)
         self.transaction_sequence = transaction_sequence
         self.source_location = source_location

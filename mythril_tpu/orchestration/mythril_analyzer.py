@@ -29,6 +29,9 @@ class MythrilAnalyzer:
         strategy: str = "bfs",
         address: Optional[str] = None,
     ):
+        from ..support.start_time import StartTime
+
+        StartTime()  # anchor issue discovery_time to analysis start
         self.eth = disassembler.eth
         self.contracts = disassembler.contracts or []
         self.enable_online_lookup = disassembler.enable_online_lookup

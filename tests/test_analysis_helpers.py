@@ -1,0 +1,50 @@
+"""Analysis-layer helpers (capability parity:
+mythril/analysis/call_helpers.py, support/start_time.py)."""
+
+import time
+
+def test_call_helpers_parses_call_stack():
+    """analysis.call_helpers.get_call_from_state mirrors the reference
+    helper: parse a CALL's stack into an ops.Call record."""
+    from mythril_tpu.analysis.call_helpers import get_call_from_state
+    from mythril_tpu.analysis.ops import VarType
+    from tests.harness import ADDR, asm, push, run_concrete
+    from mythril_tpu.laser.svm import LaserEVM
+
+    seen = {}
+    orig = LaserEVM.execute_state
+
+    def patched(self, gs):
+        if gs.get_current_instruction()["opcode"] == "CALL":
+            seen["call"] = get_call_from_state(gs)
+        return orig(self, gs)
+
+    LaserEVM.execute_state = patched
+    try:
+        program = (
+            push(0, 1) + push(0, 1) + push(0, 1) + push(0, 1)
+            + push(0, 1)          # value
+            + push(0xBEEF)        # to
+            + push(300000, 3)     # gas
+            + asm("CALL", "STOP")
+        )
+        run_concrete(bytes(program))
+    finally:
+        LaserEVM.execute_state = orig
+    call = seen["call"]
+    assert call.to.type == VarType.CONCRETE
+    assert call.to.val == 0xBEEF
+
+
+def test_issue_discovery_time_is_elapsed_not_epoch():
+    """Issue.discovery_time measures seconds since analysis start
+    (reference report.py:69), not absolute epoch time."""
+    from mythril_tpu.analysis.report import Issue
+    from mythril_tpu.support.start_time import StartTime
+
+    StartTime()  # ensure the singleton exists
+    issue = Issue(
+        contract="C", function_name="f", address=1, swc_id="106",
+        title="t", bytecode="00", severity="High",
+    )
+    assert 0 <= issue.discovery_time < time.time() - 1e6

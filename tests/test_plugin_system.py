@@ -80,3 +80,4 @@ def test_discovery_lists_no_plugins_in_clean_env():
     assert not disc.is_installed("nonexistent-plugin-xyz")
     with pytest.raises(ValueError):
         disc.build_plugin("nonexistent-plugin-xyz", {})
+
