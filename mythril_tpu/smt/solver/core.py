@@ -218,6 +218,166 @@ class CheckContext:
         self.stats = {}
 
 
+class _IncrementalSession:
+    """Process-wide assumption-based incremental solving session.
+
+    Tseitin definitions (bidirectional equivalences) and Ackermann
+    congruence axioms are universally valid, so they accumulate as
+    permanent clauses in ONE native CDCL instance; a query is just the
+    set of its constraints' root literals passed as assumptions. Each
+    term in the (globally hash-consed) DAG is therefore blasted at most
+    once per process, and learned clauses carry across the thousands of
+    near-identical path-feasibility checks the engine issues
+    (reference behavior: a fresh z3 solver per query)."""
+
+    def __init__(self):
+        self.sat = SatSolver()
+        self.blaster = Blaster(self.sat)
+        # ackermannization state shared across queries
+        self.ack_cache: Dict[int, "T.Term"] = {}  # select/apply tid -> var
+        self.select_map: Dict[str, list] = {}
+        self.apply_map: Dict[str, list] = {}
+        self._ack_counter = [0]
+        # constraint tid -> (root lit, ackermann-expanded term)
+        self._prepared: Dict[int, tuple] = {}
+
+    def prepare(self, work: List["T.Term"]) -> Tuple[List[int], list]:
+        """(assumption literals, expanded terms) for a constraint list,
+        blasting any terms not yet known to the session."""
+        lits = []
+        expanded_terms = []
+        for t in work:
+            entry = self._prepared.get(t.tid)
+            if entry is None:
+                expanded = self._ackermannize_term(t)
+                self.blaster._ensure_blasted(expanded)
+                entry = (self.blaster.bool_lit(expanded), expanded)
+                self._prepared[t.tid] = entry
+            lits.append(entry[0])
+            expanded_terms.append(entry[1])
+        return lits, expanded_terms
+
+    def _ackermannize_term(self, t: "T.Term") -> "T.Term":
+        """Eliminate SELECT/APPLY via session-cached fresh variables,
+        asserting congruence axioms permanently as new instances appear."""
+        for _ in range(64):
+            targets: List["T.Term"] = []
+            T.collect(t, lambda x: x.op in (T.SELECT, T.APPLY), targets)
+            inner = [
+                x
+                for x in targets
+                if not any(
+                    T.collect(arg, lambda y: y.op in (T.SELECT, T.APPLY))
+                    for arg in x.args
+                )
+            ]
+            if not inner:
+                return t
+            mapping = {}
+            for x in inner:
+                cached = self.ack_cache.get(x.tid)
+                if cached is not None:
+                    mapping[x.tid] = cached
+                    continue
+                self._ack_counter[0] += 1
+                fresh = T.bv_var(
+                    f"__ack_{self._ack_counter[0]}", x.width
+                )
+                self.ack_cache[x.tid] = fresh
+                mapping[x.tid] = fresh
+                if x.op == T.SELECT:
+                    base = x.args[0]
+                    if base.op == T.CONST_ARRAY:
+                        self._assert_axiom(
+                            T.mk_eq(fresh, base.args[0])
+                        )
+                        continue
+                    name = base.name
+                    for (idx2, var2) in self.select_map.get(name, ()):
+                        self._assert_axiom(
+                            T.mk_bool_or(
+                                T.mk_not(T.mk_eq(x.args[1], idx2)),
+                                T.mk_eq(fresh, var2),
+                            )
+                        )
+                    self.select_map.setdefault(name, []).append(
+                        (x.args[1], fresh)
+                    )
+                else:
+                    name = x.name
+                    for (args2, var2) in self.apply_map.get(name, ()):
+                        hyp = [
+                            T.mk_not(T.mk_eq(a1, a2))
+                            for a1, a2 in zip(x.args, args2)
+                        ]
+                        self._assert_axiom(
+                            T.mk_bool_or(*hyp, T.mk_eq(fresh, var2))
+                        )
+                    self.apply_map.setdefault(name, []).append(
+                        (x.args, fresh)
+                    )
+            t = T.substitute_term(t, mapping)
+        return t
+
+    def _assert_axiom(self, axiom: "T.Term") -> None:
+        """Congruence axioms may themselves contain selects/applies in
+        their index terms; expand before asserting permanently."""
+        expanded = self._ackermannize_term(axiom)
+        self.blaster.assert_term(expanded)
+
+
+_session: Optional[_IncrementalSession] = None
+_SESSION_VAR_LIMIT = 3_000_000
+
+# set False to fall back to one-shot solving (fresh instance per query)
+INCREMENTAL = True
+
+
+def _get_session() -> _IncrementalSession:
+    global _session
+    if _session is None or _session.sat.nvars > _SESSION_VAR_LIMIT:
+        _session = _IncrementalSession()
+    return _session
+
+
+def _check_incremental(ctx, work, timeout_s, conflict_budget, minimize,
+                       maximize, t0) -> CheckContext:
+    """Assumption-based query against the shared session (see
+    _IncrementalSession)."""
+    sess = _get_session()
+    try:
+        lits, expanded = sess.prepare(work)
+    except Exception:
+        # a failure mid-ackermannization can leave a cached fresh var
+        # without its congruence axioms; discard the whole session so
+        # later queries cannot observe the inconsistent state
+        global _session
+        _session = None
+        raise
+
+    remaining = timeout_s - (time.monotonic() - t0)
+    if remaining <= 0:
+        ctx.status = UNKNOWN
+        return ctx
+    res = sess.sat.solve(
+        assumptions=lits, timeout=remaining, conflicts=conflict_budget
+    )
+    if res is None:
+        ctx.status = UNKNOWN
+        return ctx
+    if res is False:
+        ctx.status = UNSAT
+        return ctx
+
+    ctx.status = SAT
+    ctx.model = _extract_model(
+        sess.blaster, sess.sat, {}, sess.select_map, sess.apply_map,
+        scope=_query_scope(work, expanded),
+    )
+    ctx.stats = sess.sat.stats()
+    return ctx
+
+
 def check(
     assertions: List["T.Term"],
     timeout_s: float = 10.0,
@@ -236,12 +396,6 @@ def check(
         return ctx
     work = [a for a in work if a.op != T.TRUE]
 
-    work, subs = _equality_propagation(work)
-    if any(a.op == T.FALSE for a in work):
-        ctx.status = UNSAT
-        return ctx
-    work = [a for a in work if a.op != T.TRUE]
-
     # interval pre-filter (host twin of the TPU lane pruner)
     memo: Dict[int, object] = {}
     for a in work:
@@ -249,6 +403,29 @@ def check(
         if not mt:
             ctx.status = UNSAT
             return ctx
+
+    # Plain satisfiability checks (the engine's thousands of per-fork
+    # `is_possible` queries over growing path-constraint prefixes) run
+    # against the shared incremental session: every term blasts at most
+    # once per process and learned clauses persist. Optimization queries
+    # (rare; one per reported issue) stay on the one-shot path — their
+    # binary-search probes are much cheaper against a small bespoke
+    # formula than against the session's accumulated clause set.
+    if INCREMENTAL and not minimize and not maximize:
+        try:
+            return _check_incremental(
+                ctx, work, timeout_s, conflict_budget, minimize,
+                maximize, t0,
+            )
+        except NotImplementedError:
+            pass  # unsupported term shape: fall through to one-shot
+
+    # ---- one-shot path (fresh instance; also the fallback) ---------------
+    work, subs = _equality_propagation(work)
+    if any(a.op == T.FALSE for a in work):
+        ctx.status = UNSAT
+        return ctx
+    work = [a for a in work if a.op != T.TRUE]
 
     work, select_map, apply_map = _ackermannize(work)
     work = [a for a in work if a.op != T.TRUE]
@@ -382,33 +559,74 @@ def _optimize_objectives(blaster, sat, minimize, maximize, subs, timeout_s,
     # restore a model consistent with whatever got fixed; fall back to the
     # unconstrained problem if even that probe is over budget
     r = sat.solve(
-        assumptions=fixed, timeout=max(1.0, timeout_s - (time.monotonic() - t0))
+        assumptions=fixed,
+        timeout=max(1.0, timeout_s - (time.monotonic() - t0)),
     )
     if r is not True:
-        r = sat.solve(timeout=max(1.0, timeout_s - (time.monotonic() - t0)))
+        r = sat.solve(
+            timeout=max(1.0, timeout_s - (time.monotonic() - t0))
+        )
     return r is True
 
 
-def _extract_model(blaster, sat, subs, select_map, apply_map) -> ModelData:
-    md = ModelData()
-    # blasted variables
-    for key, bits in list(blaster._bv.items()):
-        if not isinstance(key, int):
-            continue
-        t = _term_by_tid(key)
-        if t is not None and t.op == T.BV_VAR and not t.name.startswith(
-            "__ack_"
+def _query_scope(work, expanded):
+    """(var terms, array names, function names) reachable from a query:
+    restricts session-wide model extraction to what the caller can ask
+    about — extraction iterates the query's own variable terms instead
+    of walking the session's full _bv/_bool maps (which span every
+    query ever made and grow for the life of the process)."""
+    var_terms, arrays, funcs = [], set(), set()
+    seen: set = set()
+    seen_vars: set = set()
+    for t in list(work) + list(expanded):
+        for v in T.collect(
+            t,
+            lambda x: x.op in (T.BV_VAR, T.BOOL_VAR, T.ARRAY_VAR,
+                               T.APPLY),
+            seen=seen,
         ):
-            md.bv[t.name] = blaster.model_value(t)
-    for key, lit in list(blaster._bool.items()):
-        t = _term_by_tid(key)
-        if t is not None and t.op == T.BOOL_VAR:
-            md.bools[t.name] = bool(blaster.model_value(t))
+            if v.op == T.ARRAY_VAR:
+                arrays.add(v.name)
+            elif v.op == T.APPLY:
+                funcs.add(v.name)
+            elif v.tid not in seen_vars:
+                seen_vars.add(v.tid)
+                var_terms.append(v)
+    return var_terms, arrays, funcs
+
+
+def _extract_model(blaster, sat, subs, select_map, apply_map,
+                   scope=None) -> ModelData:
+    md = ModelData()
+    arr_names = func_names = None
+    if scope is not None:
+        scope_vars, arr_names, func_names = scope
+        for t in scope_vars:
+            if t.op == T.BV_VAR:
+                if not t.name.startswith("__ack_") and t.tid in blaster._bv:
+                    md.bv[t.name] = blaster.model_value(t)
+            elif t.tid in blaster._bool:
+                md.bools[t.name] = bool(blaster.model_value(t))
+    else:
+        for key, bits in list(blaster._bv.items()):
+            if not isinstance(key, int):
+                continue
+            t = _term_by_tid(key)
+            if t is not None and t.op == T.BV_VAR and not t.name.startswith(
+                "__ack_"
+            ):
+                md.bv[t.name] = blaster.model_value(t)
+        for key, lit in list(blaster._bool.items()):
+            t = _term_by_tid(key)
+            if t is not None and t.op == T.BOOL_VAR:
+                md.bools[t.name] = bool(blaster.model_value(t))
     env = T.EvalEnv(bv=dict(md.bv, **md.bools), arrays=md.arrays,
                     funcs=md.funcs, complete=True)
     # arrays from ackermann select instances (before subs eval: rhs terms may
     # contain selects which eval_term resolves through env.arrays)
     for name, entries in select_map.items():
+        if arr_names is not None and name not in arr_names:
+            continue
         table: Dict[int, int] = {}
         for idx_t, var_t in entries:
             if idx_t.tid in blaster._bv:
@@ -422,6 +640,8 @@ def _extract_model(blaster, sat, subs, select_map, apply_map) -> ModelData:
             table.setdefault(idx_v, val_v)
         md.arrays[name] = (0, table)
     for name, entries in apply_map.items():
+        if func_names is not None and name not in func_names:
+            continue
         table2: Dict[tuple, int] = {}
         for args_t, var_t in entries:
             key2 = tuple(
