@@ -119,6 +119,37 @@ def _free_var_tids(t: "T.Term") -> frozenset:
     return _FREE_CACHE[t.tid]
 
 
+
+def _congruence_axioms(x, fresh, select_map, apply_map):
+    """Axiom terms tying a new Ackermann variable to previously seen
+    instances, plus the (map, key, entry) registration to perform once
+    the axioms are safely asserted. Shared by the one-shot and
+    incremental paths so their semantics cannot drift."""
+    axioms = []
+    if x.op == T.SELECT:
+        base = x.args[0]
+        if base.op == T.CONST_ARRAY:
+            axioms.append(T.mk_eq(fresh, base.args[0]))
+            return axioms, None
+        name = base.name
+        for (idx2, var2) in select_map.get(name, ()):
+            axioms.append(
+                T.mk_bool_or(
+                    T.mk_not(T.mk_eq(x.args[1], idx2)),
+                    T.mk_eq(fresh, var2),
+                )
+            )
+        return axioms, (select_map, name, (x.args[1], fresh))
+    name = x.name
+    for (args2, var2) in apply_map.get(name, ()):
+        hyp = [
+            T.mk_not(T.mk_eq(a1, a2))
+            for a1, a2 in zip(x.args, args2)
+        ]
+        axioms.append(T.mk_bool_or(*hyp, T.mk_eq(fresh, var2)))
+    return axioms, (apply_map, name, (x.args, fresh))
+
+
 def _ackermannize(assertions):
     """Replace SELECT/APPLY instances with fresh vars + congruence axioms.
 
@@ -159,35 +190,13 @@ def _ackermannize(assertions):
                 counter[0] += 1
                 fresh = T.bv_var(f"__ack_{counter[0]}", x.width)
                 mapping[x.tid] = fresh
-                if x.op == T.SELECT:
-                    base = x.args[0]
-                    # walk store chain: mk_select already reduced stores,
-                    # so base is ARRAY_VAR or CONST_ARRAY
-                    if base.op == T.CONST_ARRAY:
-                        extra.append(T.mk_eq(fresh, base.args[0]))
-                        continue
-                    name = base.name
-                    entry = (x.args[1], fresh)
-                    for (idx2, var2) in select_map.get(name, ()):
-                        extra.append(
-                            T.mk_bool_or(
-                                T.mk_not(T.mk_eq(x.args[1], idx2)),
-                                T.mk_eq(fresh, var2),
-                            )
-                        )
-                    select_map.setdefault(name, []).append(entry)
-                else:
-                    name = x.name
-                    entry = (x.args, fresh)
-                    for (args2, var2) in apply_map.get(name, ()):
-                        hyp = [
-                            T.mk_not(T.mk_eq(a1, a2))
-                            for a1, a2 in zip(x.args, args2)
-                        ]
-                        extra.append(
-                            T.mk_bool_or(*hyp, T.mk_eq(fresh, var2))
-                        )
-                    apply_map.setdefault(name, []).append(entry)
+                axioms, reg = _congruence_axioms(
+                    x, fresh, select_map, apply_map
+                )
+                extra.extend(axioms)
+                if reg is not None:
+                    target, name, entry = reg
+                    target.setdefault(name, []).append(entry)
             memo: Dict[int, T.Term] = {}
             out = [T.substitute_term(a, mapping, memo) for a in out]
             extra = [T.substitute_term(a, mapping, memo) for a in extra]
@@ -238,6 +247,7 @@ class _IncrementalSession:
         self.select_map: Dict[str, list] = {}
         self.apply_map: Dict[str, list] = {}
         self._ack_counter = [0]
+        self._dirty = False
         # constraint tid -> (root lit, ackermann-expanded term)
         self._prepared: Dict[int, tuple] = {}
 
@@ -259,7 +269,16 @@ class _IncrementalSession:
 
     def _ackermannize_term(self, t: "T.Term") -> "T.Term":
         """Eliminate SELECT/APPLY via session-cached fresh variables,
-        asserting congruence axioms permanently as new instances appear."""
+        asserting congruence axioms permanently as new instances appear.
+        Sets _dirty while the shared caches are mid-mutation: an
+        exception with _dirty set means the session may hold an Ackermann
+        variable without its axioms and must be discarded."""
+        self._dirty = True
+        out = self._ackermannize_inner(t)
+        self._dirty = False
+        return out
+
+    def _ackermannize_inner(self, t: "T.Term") -> "T.Term":
         for _ in range(64):
             targets: List["T.Term"] = []
             T.collect(t, lambda x: x.op in (T.SELECT, T.APPLY), targets)
@@ -285,44 +304,21 @@ class _IncrementalSession:
                 )
                 self.ack_cache[x.tid] = fresh
                 mapping[x.tid] = fresh
-                if x.op == T.SELECT:
-                    base = x.args[0]
-                    if base.op == T.CONST_ARRAY:
-                        self._assert_axiom(
-                            T.mk_eq(fresh, base.args[0])
-                        )
-                        continue
-                    name = base.name
-                    for (idx2, var2) in self.select_map.get(name, ()):
-                        self._assert_axiom(
-                            T.mk_bool_or(
-                                T.mk_not(T.mk_eq(x.args[1], idx2)),
-                                T.mk_eq(fresh, var2),
-                            )
-                        )
-                    self.select_map.setdefault(name, []).append(
-                        (x.args[1], fresh)
-                    )
-                else:
-                    name = x.name
-                    for (args2, var2) in self.apply_map.get(name, ()):
-                        hyp = [
-                            T.mk_not(T.mk_eq(a1, a2))
-                            for a1, a2 in zip(x.args, args2)
-                        ]
-                        self._assert_axiom(
-                            T.mk_bool_or(*hyp, T.mk_eq(fresh, var2))
-                        )
-                    self.apply_map.setdefault(name, []).append(
-                        (x.args, fresh)
-                    )
+                axioms, reg = _congruence_axioms(
+                    x, fresh, self.select_map, self.apply_map
+                )
+                for axiom in axioms:
+                    self._assert_axiom(axiom)
+                if reg is not None:
+                    target, name, entry = reg
+                    target.setdefault(name, []).append(entry)
             t = T.substitute_term(t, mapping)
         return t
 
     def _assert_axiom(self, axiom: "T.Term") -> None:
         """Congruence axioms may themselves contain selects/applies in
         their index terms; expand before asserting permanently."""
-        expanded = self._ackermannize_term(axiom)
+        expanded = self._ackermannize_inner(axiom)
         self.blaster.assert_term(expanded)
 
 
@@ -340,19 +336,22 @@ def _get_session() -> _IncrementalSession:
     return _session
 
 
-def _check_incremental(ctx, work, timeout_s, conflict_budget, minimize,
-                       maximize, t0) -> CheckContext:
+def _check_incremental(ctx, work, timeout_s, conflict_budget,
+                       t0) -> CheckContext:
     """Assumption-based query against the shared session (see
     _IncrementalSession)."""
     sess = _get_session()
     try:
         lits, expanded = sess.prepare(work)
     except Exception:
-        # a failure mid-ackermannization can leave a cached fresh var
-        # without its congruence axioms; discard the whole session so
-        # later queries cannot observe the inconsistent state
-        global _session
-        _session = None
+        # a failure while the ackermann caches were mid-mutation can
+        # leave a fresh var without its congruence axioms: discard the
+        # session. Failures after the caches settled (e.g. the blaster
+        # rejecting an op) leave consistent state — keep the session and
+        # let the one-shot fallback handle this query.
+        if sess._dirty:
+            global _session
+            _session = None
         raise
 
     remaining = timeout_s - (time.monotonic() - t0)
@@ -414,8 +413,7 @@ def check(
     if INCREMENTAL and not minimize and not maximize:
         try:
             return _check_incremental(
-                ctx, work, timeout_s, conflict_budget, minimize,
-                maximize, t0,
+                ctx, work, timeout_s, conflict_budget, t0,
             )
         except NotImplementedError:
             pass  # unsupported term shape: fall through to one-shot
@@ -598,9 +596,14 @@ def _query_scope(work, expanded):
 def _extract_model(blaster, sat, subs, select_map, apply_map,
                    scope=None) -> ModelData:
     md = ModelData()
-    arr_names = func_names = None
+    arr_names = func_names = ack_tids = None
     if scope is not None:
         scope_vars, arr_names, func_names = scope
+        ack_tids = {
+            t.tid
+            for t in scope_vars
+            if t.op == T.BV_VAR and t.name.startswith("__ack_")
+        }
         for t in scope_vars:
             if t.op == T.BV_VAR:
                 if not t.name.startswith("__ack_") and t.tid in blaster._bv:
@@ -627,6 +630,10 @@ def _extract_model(blaster, sat, subs, select_map, apply_map,
     for name, entries in select_map.items():
         if arr_names is not None and name not in arr_names:
             continue
+        if ack_tids is not None:
+            # only this query's select instances: entry lists are shared
+            # across every query that ever touched this array name
+            entries = [e for e in entries if e[1].tid in ack_tids]
         table: Dict[int, int] = {}
         for idx_t, var_t in entries:
             if idx_t.tid in blaster._bv:
@@ -642,6 +649,8 @@ def _extract_model(blaster, sat, subs, select_map, apply_map,
     for name, entries in apply_map.items():
         if func_names is not None and name not in func_names:
             continue
+        if ack_tids is not None:
+            entries = [e for e in entries if e[1].tid in ack_tids]
         table2: Dict[tuple, int] = {}
         for args_t, var_t in entries:
             key2 = tuple(

@@ -1,0 +1,154 @@
+"""State-object unit tests (this build's analog of the reference's
+tests/laser/state/ suite: mstack_test.py, mstate_test.py,
+storage_test.py, world_state_account_exist_load_test.py)."""
+
+import pytest
+
+from mythril_tpu.laser.evm_exceptions import StackUnderflowException
+from mythril_tpu.laser.state.account import Account, Storage
+from mythril_tpu.laser.state.machine_state import MachineState, MachineStack
+from mythril_tpu.laser.state.world_state import WorldState
+from mythril_tpu.smt import BitVec, symbol_factory
+from mythril_tpu.support.eth_constants import STACK_LIMIT
+
+
+# -- MachineStack ------------------------------------------------------------
+
+def test_stack_wraps_ints_as_bitvecs():
+    st = MachineStack()
+    st.append(5)
+    assert isinstance(st[-1], BitVec)
+    assert st[-1].value == 5
+    assert st[-1].size() == 256
+
+
+def test_stack_pop_order_and_underflow():
+    st = MachineStack()
+    st.append(1)
+    st.append(2)
+    assert st.pop().value == 2
+    assert st.pop().value == 1
+    with pytest.raises(StackUnderflowException):
+        st.pop()
+
+
+def test_stack_getitem_underflow():
+    st = MachineStack()
+    st.append(1)
+    with pytest.raises(StackUnderflowException):
+        st[-5]
+
+
+def test_stack_limit():
+    st = MachineStack()
+    for i in range(STACK_LIMIT):
+        st.append(i)
+    with pytest.raises(Exception):
+        st.append(1)
+
+
+# -- MachineState gas --------------------------------------------------------
+
+def test_mem_extend_charges_quadratic_gas():
+    ms = MachineState(gas_limit=10**9)
+    base_min = ms.min_gas_used
+    ms.mem_extend(0, 32)
+    one_word = ms.min_gas_used - base_min
+    assert one_word == 3  # GAS_MEMORY per word, no quadratic term yet
+    ms2 = MachineState(gas_limit=10**9)
+    ms2.mem_extend(0, 32 * 1024)  # 1024 words: quadratic term kicks in
+    words = 1024
+    expected = words * 3 + words * words // 512
+    assert ms2.min_gas_used == expected
+    assert len(ms2.memory) == 32 * 1024
+
+
+def test_mem_extend_is_idempotent_for_covered_ranges():
+    ms = MachineState(gas_limit=10**9)
+    ms.mem_extend(0, 64)
+    g = ms.min_gas_used
+    ms.mem_extend(0, 32)  # already covered: no new gas, no growth
+    assert ms.min_gas_used == g
+    assert len(ms.memory) == 64
+
+
+def test_machine_state_pop_multiple():
+    ms = MachineState(gas_limit=10**9)
+    ms.stack.append(1)
+    ms.stack.append(2)
+    ms.stack.append(3)
+    a, b = ms.pop(2)
+    assert (a.value, b.value) == (3, 2)
+    with pytest.raises(StackUnderflowException):
+        ms.pop(5)
+
+
+# -- Storage -----------------------------------------------------------------
+
+def test_concrete_storage_defaults_to_zero():
+    s = Storage(concrete=True,
+                address=symbol_factory.BitVecVal(0xAA, 256))
+    v = s[symbol_factory.BitVecVal(7, 256)]
+    assert v.value == 0
+
+
+def test_symbolic_storage_read_is_symbolic():
+    s = Storage(concrete=False,
+                address=symbol_factory.BitVecVal(0xAA, 256))
+    v = s[symbol_factory.BitVecVal(7, 256)]
+    assert v.symbolic
+
+
+def test_storage_write_then_read():
+    s = Storage(concrete=True,
+                address=symbol_factory.BitVecVal(0xAA, 256))
+    key = symbol_factory.BitVecVal(3, 256)
+    s[key] = symbol_factory.BitVecVal(99, 256)
+    assert s[key].value == 99
+    assert s.printable_storage[key].value == 99
+
+
+# -- WorldState --------------------------------------------------------------
+
+def test_world_state_auto_creates_on_getitem():
+    ws = WorldState()
+    acct = ws[symbol_factory.BitVecVal(0x1234, 256)]
+    assert acct.address.value == 0x1234
+    assert 0x1234 in ws.accounts
+
+
+def test_accounts_exist_or_load_raises_without_loader():
+    ws = WorldState()
+    with pytest.raises(ValueError):
+        ws.accounts_exist_or_load("0x1234", None)
+
+
+def test_world_state_copy_isolates_accounts():
+    ws = WorldState()
+    a = ws.create_account(address=0xAA, concrete_storage=True)
+    a.storage[symbol_factory.BitVecVal(1, 256)] = (
+        symbol_factory.BitVecVal(7, 256)
+    )
+    ws2 = ws.__copy__()
+    ws2.accounts[0xAA].storage[symbol_factory.BitVecVal(1, 256)] = (
+        symbol_factory.BitVecVal(8, 256)
+    )
+    assert ws.accounts[0xAA].storage[
+        symbol_factory.BitVecVal(1, 256)
+    ].value == 7
+    assert ws2.accounts[0xAA].storage[
+        symbol_factory.BitVecVal(1, 256)
+    ].value == 8
+
+
+def test_create_account_derives_create_address():
+    ws = WorldState()
+    creator = 0xAFFE
+    ws.create_account(address=creator)
+    created = ws.create_account(creator=creator)
+    from mythril_tpu.support.support_utils import sha3
+
+    # rlp([20-byte address, nonce 0])
+    rlp = b"\xd6\x94" + creator.to_bytes(20, "big") + b"\x80"
+    expected = int.from_bytes(sha3(rlp)[12:], "big")
+    assert created.address.value == expected
