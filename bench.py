@@ -50,7 +50,7 @@ def build_contract():
     return bytes(code)
 
 
-def bench_device(code, n_lanes=4096, repeats=3):
+def bench_device(code, n_lanes=32768, repeats=3):
     """Lane engine: concrete path batch to completion on one chip."""
     import jax
 

@@ -318,12 +318,32 @@ def bytes_be_to_word(b):
     return jnp.stack(limbs[::-1], axis=-1)
 
 
+def _onehot_gather(arr, idx):
+    """arr[lane, idx[lane], :] as a dense one-hot multiply-reduce:
+    per-lane dynamic gathers/scatters lower poorly on TPU, while the
+    dense (N, S) select rides the VPU (measured ~6x whole-stepper
+    throughput vs take_along_axis)."""
+    size = arr.shape[1]
+    onehot = jnp.arange(size)[None, :] == idx[:, None]  # (N, S)
+    return jnp.sum(jnp.where(onehot[:, :, None], arr, 0), axis=1)
+
+
 def _peek(stack, sp, k):
     """Word at stack position sp-k (k>=1); clip-guarded (caller masks)."""
-    idx = jnp.clip(sp - k, 0, stack.shape[1] - 1)
-    return jnp.take_along_axis(
-        stack, idx[:, None, None].repeat(bv256.NLIMBS, axis=2), axis=1
-    )[:, 0, :]
+    return _onehot_gather(
+        stack, jnp.clip(sp - k, 0, stack.shape[1] - 1)
+    )
+
+
+def _scatter_word(stack, lane_mask, idx, value):
+    """stack[lane, idx[lane]] = value[lane] where lane_mask — as a dense
+    one-hot select (see _peek)."""
+    depth = stack.shape[1]
+    onehot = (
+        (jnp.arange(depth)[None, :] == idx[:, None])
+        & lane_mask[:, None]
+    )
+    return jnp.where(onehot[:, :, None], value[:, None, :], stack)
 
 
 def _u32_of(word):
@@ -522,11 +542,7 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
         best = jnp.max(match_score, axis=1)  # (N,) 0 = miss
         found = best > 0
         found_idx = jnp.clip(best - 1, 0, s_slots - 1)
-        sload = jnp.take_along_axis(
-            st.svals,
-            found_idx[:, None, None].repeat(bv256.NLIMBS, axis=2),
-            axis=1,
-        )[:, 0, :]
+        sload = _onehot_gather(st.svals, found_idx)
         sload = jnp.where(found[:, None], sload, 0).astype(jnp.uint32)
 
         store_pos = jnp.where(found, found_idx, st.scount)
@@ -573,13 +589,7 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
 
     # ---- env words / misc push-only results ------------------------------
     env_idx = ENV_TABLE[op]
-    env_r = jnp.take_along_axis(
-        st.env,
-        jnp.clip(env_idx, 0, N_ENV - 1)[:, None, None].repeat(
-            bv256.NLIMBS, axis=2
-        ),
-        axis=1,
-    )[:, 0, :]
+    env_r = _onehot_gather(st.env, jnp.clip(env_idx, 0, N_ENV - 1))
     pc_r = bv256.from_u32(st.pc.astype(jnp.uint32))
     gas_r = bv256.from_u32(st.gas_limit - st.gas_used)
     cds_r = bv256.from_u32(st.cd_size.astype(jnp.uint32))
@@ -604,24 +614,20 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
     )
     result = lax.select_n(which, *cases)
 
-    # ---- generic stack update -------------------------------------------
+    # ---- generic stack update (dense one-hot scatters; see _peek) --------
     parked = unsupported | mem_oob | cd_oob | storage_full | overflow
     new_sp = st.sp - npop + npush
     do_push = running & (npush == 1) & ~underflow & ~parked
-    push_idx = jnp.where(do_push, jnp.clip(new_sp - 1, 0, depth - 1), depth)
-    stack = st.stack.at[lanes, push_idx].set(result, mode="drop")
+    push_idx = jnp.clip(new_sp - 1, 0, depth - 1)
+    stack = _scatter_word(st.stack, do_push, push_idx, result)
 
     # SWAPn: exchange top with top-n (no sp change)
     do_swap = running & is_swap & ~underflow
     top_idx = jnp.clip(st.sp - 1, 0, depth - 1)
     swap_idx = jnp.clip(st.sp - 1 - swap_n, 0, depth - 1)
     swap_val = _peek(st.stack, st.sp, swap_n + 1)
-    stack = stack.at[
-        lanes, jnp.where(do_swap, top_idx, depth)
-    ].set(swap_val, mode="drop")
-    stack = stack.at[
-        lanes, jnp.where(do_swap, swap_idx, depth)
-    ].set(a, mode="drop")
+    stack = _scatter_word(stack, do_swap, top_idx, swap_val)
+    stack = _scatter_word(stack, do_swap, swap_idx, a)
 
     # ---- control flow ----------------------------------------------------
     dest_u32, dest_hi = _u32_of(a)
