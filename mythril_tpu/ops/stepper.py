@@ -548,9 +548,9 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
         store_pos = jnp.where(found, found_idx, st.scount)
         full = is_sstore & ~found & (st.scount >= s_slots)
         do_sstore = running & is_sstore & ~full & ~underflow
-        pos_c = jnp.where(do_sstore, store_pos, s_slots)
-        sk = st.skeys.at[lanes, pos_c].set(key, mode="drop")
-        sv = st.svals.at[lanes, pos_c].set(b, mode="drop")
+        pos_c = jnp.clip(store_pos, 0, s_slots - 1)
+        sk = _scatter_word(st.skeys, do_sstore, pos_c, key)
+        sv = _scatter_word(st.svals, do_sstore, pos_c, b)
         sc = jnp.where(do_sstore & ~found, st.scount + 1, st.scount)
         return sk, sv, sc, sload, full
 

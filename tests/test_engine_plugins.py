@@ -1,0 +1,98 @@
+"""LASER engine plugin behavior (this build's analog of plugin-level
+coverage the reference exercises implicitly): mutation pruner drops
+clean end states, coverage plugin tracks executed instructions, call
+depth limiter cuts deep call chains."""
+
+from datetime import datetime
+
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.laser.plugin.plugins.call_depth_limiter import (
+    CallDepthLimit,
+)
+from mythril_tpu.laser.plugin.plugins.coverage.coverage_plugin import (
+    InstructionCoveragePlugin,
+)
+from mythril_tpu.laser.plugin.plugins.mutation_pruner import MutationPruner
+from mythril_tpu.laser.state.world_state import WorldState
+from mythril_tpu.laser.svm import LaserEVM
+from mythril_tpu.laser.time_handler import time_handler
+from mythril_tpu.laser.transaction.symbolic import execute_message_call
+from mythril_tpu.smt import symbol_factory
+from tests.harness import ADDR, asm, push
+
+
+def _run_symbolic(code: bytes, plugins=()):
+    laser = LaserEVM(requires_statespace=False, execution_timeout=60,
+                     transaction_count=1)
+    for plugin in plugins:
+        plugin.initialize(laser)
+    world_state = WorldState()
+    account = world_state.create_account(
+        address=ADDR, concrete_storage=True)
+    account.code = Disassembly(code.hex())
+    laser.open_states = [world_state]
+    laser.time = datetime.now()
+    time_handler.start_execution(60)
+    execute_message_call(
+        laser, callee_address=symbol_factory.BitVecVal(ADDR, 256))
+    return laser
+
+
+def test_mutation_pruner_drops_clean_end_states():
+    """A non-payable-style path (callvalue constrained to 0) with no
+    mutation yields no open state when the mutation pruner is loaded.
+    (A bare STOP is kept: its unconstrained symbolic callvalue may be
+    positive, which counts as a balance mutation — reference
+    semantics.)"""
+    # callvalue != 0 -> revert at 5; else STOP (clean, value-free path)
+    code = bytes(
+        asm("CALLVALUE") + push(5, 1) + asm("JUMPI", "STOP", "JUMPDEST")
+        + push(0, 1) + push(0, 1) + asm("REVERT")
+    )
+    laser = _run_symbolic(code, plugins=[MutationPruner()])
+    assert len(laser.open_states) == 0
+
+    laser2 = _run_symbolic(code)  # without the pruner the state survives
+    assert len(laser2.open_states) == 1
+
+    # bare STOP: symbolic callvalue may be > 0 -> kept even with pruner
+    laser3 = _run_symbolic(bytes(asm("STOP")),
+                           plugins=[MutationPruner()])
+    assert len(laser3.open_states) == 1
+
+
+def test_mutation_pruner_keeps_sstore_states():
+    code = bytes(push(1, 1) + push(0, 1) + asm("SSTORE", "STOP"))
+    laser = _run_symbolic(code, plugins=[MutationPruner()])
+    assert len(laser.open_states) == 1
+
+
+def test_coverage_plugin_counts_instructions():
+    code = bytes(push(1, 1) + push(0, 1) + asm("SSTORE", "STOP"))
+    plugin = InstructionCoveragePlugin()
+    _run_symbolic(code, plugins=[plugin])
+    assert plugin.coverage, "no coverage recorded"
+    total, covered = next(iter(plugin.coverage.values()))
+    assert total > 0
+    n_covered = (
+        sum(covered) if isinstance(covered, (list, tuple)) else covered
+    )
+    assert n_covered > 0
+
+
+def test_call_depth_limiter_cuts_recursion():
+    """A self-recursive CALL chain is cut at the configured depth:
+    a tighter limit must explore strictly fewer states."""
+    program = (
+        push(0, 1) + push(0, 1) + push(0, 1) + push(0, 1)
+        + push(0, 1) + push(ADDR) + push(100000, 3)
+        + asm("CALL", "STOP")
+    )
+    shallow = _run_symbolic(
+        bytes(program), plugins=[CallDepthLimit(call_depth_limit=1)]
+    )
+    deep = _run_symbolic(
+        bytes(program), plugins=[CallDepthLimit(call_depth_limit=3)]
+    )
+    assert shallow.total_states > 0
+    assert shallow.total_states < deep.total_states
