@@ -1,0 +1,89 @@
+"""Corpus benchmark: full analysis (all 14 detectors) over the
+reference's bytecode fixture corpus — the measurable stand-in for
+BASELINE.md config 4 (solidity_examples sweep; solc is absent in this
+image, so the reference's precompiled testdata .sol.o fixtures serve as
+the corpus). Prints one JSON line per contract and an aggregate.
+
+Usage: python bench_corpus.py [--timeout SECS]
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+
+# The corpus is mixed: these four fixtures are CREATION bytecode (the
+# reference's analysis_tests run them without --bin-runtime; their
+# disassembly ends in the CODECOPY/RETURN deploy prologue), everything
+# else is runtime bytecode (loaded as EVMContract(code=...) by the
+# reference's statespace/cmd-line tests).
+CREATION_FIXTURES = {
+    "flag_array.sol.o",
+    "exceptions_0.8.0.sol.o",
+    "symbolic_exec_bytecode.sol.o",
+    "extcall.sol.o",
+}
+
+
+def analyze_one(path: Path, timeout: int):
+    from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+
+    disassembler = MythrilDisassembler(eth=None)
+    code = path.read_text().strip()
+    address, _ = disassembler.load_from_bytecode(
+        code, bin_runtime=path.name not in CREATION_FIXTURES
+    )
+    cmd_args = SimpleNamespace(
+        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=None, unconstrained_storage=False,
+        parallel_solving=False, call_depth_limit=3,
+        disable_dependency_pruning=False, custom_modules_directory="",
+        solver_log=None, transaction_sequences=None,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+    t0 = time.perf_counter()
+    report = analyzer.fire_lasers(modules=None, transaction_count=2)
+    elapsed = time.perf_counter() - t0
+    issues = report.sorted_issues()
+    return {
+        "contract": path.name,
+        "wall_s": round(elapsed, 2),
+        "issues": len(issues),
+        "swc": sorted({i["swc-id"] for i in issues}),
+    }
+
+
+def main():
+    timeout = 60
+    if "--timeout" in sys.argv:
+        timeout = int(sys.argv[sys.argv.index("--timeout") + 1])
+    results = []
+    t0 = time.perf_counter()
+    for path in sorted(INPUTS.glob("*.sol.o")):
+        try:
+            r = analyze_one(path, timeout)
+        except Exception as e:  # noqa: BLE001 - keep sweeping
+            r = {"contract": path.name, "error": type(e).__name__}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    total = time.perf_counter() - t0
+    print(json.dumps({
+        "corpus": len(results),
+        "total_wall_s": round(total, 1),
+        "total_issues": sum(r.get("issues", 0) for r in results),
+        "errors": sum(1 for r in results if "error" in r),
+    }))
+
+
+if __name__ == "__main__":
+    main()

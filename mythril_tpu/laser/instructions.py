@@ -1514,7 +1514,8 @@ class Instruction:
                 callee_account.code.bytecode == ""
                 or callee_account.code.bytecode == "0x"
             ):
-                # the callee is empty: just transfer value, push retval 1
+                # the callee is empty: just transfer value, push an
+                # unconstrained success flag
                 log.debug("The call is related to ether transfer between "
                           "accounts")
                 sender = environment.active_account.address
@@ -1529,7 +1530,7 @@ class Instruction:
                 self._write_symbolic_returndata(
                     global_state, memory_out_offset, memory_out_size
                 )
-                util.insert_ret_val(global_state)
+                util.push_unconstrained_ret_val(global_state)
                 global_state.mstate.pc += 1
                 return [global_state]
         except ValueError as e:
@@ -1540,7 +1541,7 @@ class Instruction:
             self._write_symbolic_returndata(
                 global_state, out_offset_pre, out_size_pre
             )
-            util.insert_ret_val(global_state)
+            util.push_unconstrained_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
 
@@ -1604,7 +1605,7 @@ class Instruction:
                 self._write_symbolic_returndata(
                     global_state, memory_out_offset, memory_out_size
                 )
-                util.insert_ret_val(global_state)
+                util.push_unconstrained_ret_val(global_state)
                 global_state.mstate.pc += 1
                 return [global_state]
         except ValueError as e:
@@ -1615,7 +1616,7 @@ class Instruction:
             self._write_symbolic_returndata(
                 global_state, out_offset_pre, out_size_pre
             )
-            util.insert_ret_val(global_state)
+            util.push_unconstrained_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
 
@@ -1675,7 +1676,7 @@ class Instruction:
                 self._write_symbolic_returndata(
                     global_state, memory_out_offset, memory_out_size
                 )
-                util.insert_ret_val(global_state)
+                util.push_unconstrained_ret_val(global_state)
                 global_state.mstate.pc += 1
                 return [global_state]
         except ValueError as e:
@@ -1686,7 +1687,7 @@ class Instruction:
             self._write_symbolic_returndata(
                 global_state, out_offset_pre, out_size_pre
             )
-            util.insert_ret_val(global_state)
+            util.push_unconstrained_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
 
@@ -1749,7 +1750,7 @@ class Instruction:
                 self._write_symbolic_returndata(
                     global_state, memory_out_offset, memory_out_size
                 )
-                util.insert_ret_val(global_state)
+                util.push_unconstrained_ret_val(global_state)
                 global_state.mstate.pc += 1
                 return [global_state]
         except ValueError as e:
@@ -1760,7 +1761,7 @@ class Instruction:
             self._write_symbolic_returndata(
                 global_state, out_offset_pre, out_size_pre
             )
-            util.insert_ret_val(global_state)
+            util.push_unconstrained_ret_val(global_state)
             global_state.mstate.pc += 1
             return [global_state]
 
@@ -1817,22 +1818,14 @@ class Instruction:
             self._write_symbolic_returndata(
                 global_state, out_offset, out_size
             )
-            global_state.mstate.stack.append(
-                global_state.new_bitvec("retval_" + str(
-                    global_state.get_current_instruction()["address"]),
-                    256)
-            )
+            util.push_unconstrained_ret_val(global_state)
             return [global_state]
 
         try:
             memory_out_offset = util.get_concrete_int(out_offset)
             memory_out_size = util.get_concrete_int(out_size)
         except TypeError:
-            global_state.mstate.stack.append(
-                global_state.new_bitvec("retval_" + str(
-                    global_state.get_current_instruction()["address"]),
-                    256)
-            )
+            util.push_unconstrained_ret_val(global_state)
             return [global_state]
 
         # write return data to memory

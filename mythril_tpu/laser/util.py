@@ -118,3 +118,17 @@ def insert_ret_val(global_state):
     )
     global_state.mstate.stack.append(retval)
     global_state.world_state.constraints.append(retval == 1)
+
+
+def push_unconstrained_ret_val(global_state):
+    """Push a fresh UNCONSTRAINED call-success flag (reference parity:
+    the call-family empty-callee/unresolvable paths push new_bitvec with
+    no constraint — instructions.py retval pushes — so UncheckedRetval
+    can branch both ways; only native/cheat-code calls pin success via
+    insert_ret_val)."""
+    global_state.mstate.stack.append(
+        global_state.new_bitvec(
+            "retval_" + str(global_state.get_current_instruction()["address"]),
+            256,
+        )
+    )

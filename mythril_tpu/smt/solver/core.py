@@ -336,6 +336,16 @@ def _get_session() -> _IncrementalSession:
     return _session
 
 
+def reset_session() -> None:
+    """Drop the shared incremental session. Call between independent
+    analyses (e.g. per contract): constraints from different contracts
+    share no structure, so a stale session only adds dead clauses that
+    every solve must re-satisfy (measured 40x slowdown over an 18-
+    contract sweep)."""
+    global _session
+    _session = None
+
+
 def _check_incremental(ctx, work, timeout_s, conflict_budget,
                        t0) -> CheckContext:
     """Assumption-based query against the shared session (see

@@ -77,3 +77,26 @@ def committed_storage(laser, slot: int, addr: int = ADDR) -> int:
         return val
     assert val.value is not None, f"storage[{slot}] not concrete: {val}"
     return val.value
+
+
+def analyze_runtime(runtime_hex: str, modules, tx_count=1, name="test",
+                    max_depth=64):
+    """Symbolically analyze runtime bytecode with the given detection
+    modules; returns the issues (shared by the detector/e2e tests)."""
+    from mythril_tpu.analysis.security import fire_lasers
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    contract = EVMContract(code=runtime_hex, name=name)
+    sym = SymExecWrapper(
+        contract,
+        address=0xDEADBEEF,
+        strategy="bfs",
+        max_depth=max_depth,
+        execution_timeout=60,
+        create_timeout=10,
+        transaction_count=tx_count,
+        modules=modules,
+        compulsory_statespace=False,
+    )
+    return fire_lasers(sym, modules)

@@ -1,0 +1,105 @@
+"""Direct detection-module tests on minimal crafted bytecode (rounding
+out the detectors not already covered by the reference-oracle e2e tests:
+ArbitraryJump, ArbitraryStorage, MultipleSends, StateChangeAfterCall,
+UncheckedRetval, PredictableVariables)."""
+
+import logging
+
+import pytest
+
+from mythril_tpu.support.support_args import args
+from tests.harness import analyze_runtime, asm, push
+
+logging.getLogger("mythril_tpu").setLevel(logging.ERROR)
+
+
+@pytest.fixture(autouse=True)
+def _solver_timeout():
+    """Raise the solver budget for these crafted queries and restore the
+    process-global afterwards (args is a singleton shared across test
+    modules)."""
+    prev = args.solver_timeout
+    args.solver_timeout = 20000
+    yield
+    args.solver_timeout = prev
+
+
+def analyze(code: bytes, module: str):
+    return analyze_runtime(code.hex(), [module], name="crafted")
+
+
+def test_arbitrary_jump():
+    # jump destination taken straight from calldata
+    code = bytes(push(0, 1) + asm("CALLDATALOAD", "JUMP", "JUMPDEST",
+                                  "STOP"))
+    issues = analyze(code, "ArbitraryJump")
+    assert len(issues) >= 1
+    assert issues[0].swc_id == "127"
+
+
+def test_arbitrary_storage_write():
+    # sstore(key=calldata[0], value=1)
+    code = bytes(
+        push(1, 1) + push(0, 1) + asm("CALLDATALOAD", "SSTORE", "STOP")
+    )
+    issues = analyze(code, "ArbitraryStorage")
+    assert len(issues) >= 1
+    assert issues[0].swc_id == "124"
+
+
+def _call_to(addr_src: bytes) -> bytes:
+    """call(gas=100k, to=<addr_src result>, value=0, 0,0,0,0)"""
+    return bytes(
+        push(0, 1) + push(0, 1) + push(0, 1) + push(0, 1) + push(0, 1)
+        + addr_src + push(100000, 3) + asm("CALL")
+    )
+
+
+def test_multiple_sends():
+    code = (
+        _call_to(bytes(push(0xB0B, 2)))
+        + bytes(asm("POP"))
+        + _call_to(bytes(push(0xB0B, 2)))
+        + bytes(asm("POP", "STOP"))
+    )
+    issues = analyze(code, "MultipleSends")
+    assert len(issues) >= 1
+    assert issues[0].swc_id == "113"
+
+
+def test_state_change_after_call():
+    # external call to user-supplied address, then SSTORE
+    code = (
+        _call_to(bytes(push(0, 1) + asm("CALLDATALOAD")))
+        + bytes(asm("POP") + push(1, 1) + push(0, 1)
+                + asm("SSTORE", "STOP"))
+    )
+    issues = analyze(code, "StateChangeAfterCall")
+    assert len(issues) >= 1
+    assert issues[0].swc_id == "107"
+
+
+def test_unchecked_retval():
+    """A low-level call to an unresolvable address pushes an
+    UNCONSTRAINED success flag (reference call_ fallback paths push
+    new_bitvec with no ==1 pin); popping it unchecked raises SWC-104."""
+    code = _call_to(bytes(push(0xB0B, 2))) + bytes(asm("POP", "STOP"))
+    issues = analyze(code, "UncheckedRetval")
+    assert len(issues) >= 1
+    assert issues[0].swc_id == "104"
+
+
+def test_predictable_variables_timestamp():
+    # branch on block.timestamp (predictable dependence):
+    # TIMESTAMP, PUSH1 1, AND, PUSH1 <dest>, JUMPI, STOP, JUMPDEST,
+    # <call>, STOP
+    head = bytes(asm("TIMESTAMP")) + bytes(push(1, 1)) + bytes(asm("AND"))
+    dest = len(head) + 3 + 1  # +PUSH1 dest +JUMPI +STOP
+    code = (
+        head + bytes(push(dest, 1)) + bytes(asm("JUMPI", "STOP",
+                                               "JUMPDEST"))
+        + _call_to(bytes(push(0xB0B, 2))) + bytes(asm("POP", "STOP"))
+    )
+    issues = analyze(code, "PredictableVariables")
+    assert len(issues) >= 1
+    assert issues[0].swc_id in ("116", "120")

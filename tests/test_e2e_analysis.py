@@ -6,9 +6,6 @@ calldata) using this build's own assembler instead of compiled fixtures."""
 
 import pytest
 
-from mythril_tpu.analysis.security import fire_lasers
-from mythril_tpu.analysis.symbolic import SymExecWrapper
-from mythril_tpu.ethereum.evmcontract import EVMContract
 from mythril_tpu.support.opcodes import ADDRESS, OPCODES
 from mythril_tpu.support.support_utils import sha3
 
@@ -49,19 +46,11 @@ def dispatcher(entries, body):
 
 
 def analyze(runtime_hex: str, modules, tx_count=1, name="test"):
-    contract = EVMContract(code=runtime_hex, name=name)
-    sym = SymExecWrapper(
-        contract,
-        address=0xDEADBEEF,
-        strategy="bfs",
-        max_depth=60,
-        execution_timeout=60,
-        create_timeout=10,
-        transaction_count=tx_count,
-        modules=modules,
-        compulsory_statespace=False,
+    from tests.harness import analyze_runtime
+
+    return analyze_runtime(
+        runtime_hex, modules, tx_count=tx_count, name=name, max_depth=60
     )
-    return fire_lasers(sym, modules)
 
 
 def test_unprotected_selfdestruct_with_exploit():
