@@ -64,12 +64,18 @@ def analyze_one(path: Path, timeout: int):
 
 
 def main():
-    timeout = 60
-    if "--timeout" in sys.argv:
-        timeout = int(sys.argv[sys.argv.index("--timeout") + 1])
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--timeout", type=int, default=60)
+    timeout = parser.parse_args().timeout
+    fixtures = sorted(INPUTS.glob("*.sol.o"))
+    if not fixtures:
+        print(f"no *.sol.o fixtures under {INPUTS}", file=sys.stderr)
+        return 1
     results = []
     t0 = time.perf_counter()
-    for path in sorted(INPUTS.glob("*.sol.o")):
+    for path in fixtures:
         try:
             r = analyze_one(path, timeout)
         except Exception as e:  # noqa: BLE001 - keep sweeping
@@ -86,4 +92,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

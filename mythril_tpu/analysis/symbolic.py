@@ -61,12 +61,6 @@ class SymExecWrapper:
         run_analysis_modules: bool = True,
         custom_modules_directory: str = "",
     ):
-        # fresh solver session per analysis: another contract's clauses
-        # only slow this one down
-        from ..smt.solver.core import reset_session
-
-        reset_session()
-
         if isinstance(address, str):
             address = symbol_factory.BitVecVal(int(address, 16), 256)
         if isinstance(address, int):

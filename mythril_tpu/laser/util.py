@@ -111,24 +111,22 @@ def extract32(data: bytearray, i: int) -> int:
 
 
 def insert_ret_val(global_state):
-    """Push a fresh symbolic retval and pin it to 1 (success) in the path
-    constraints (reference util.py:166-173)."""
-    retval = global_state.new_bitvec(
-        "retval_" + str(global_state.get_current_instruction()["address"]), 256
-    )
-    global_state.mstate.stack.append(retval)
+    """Push a fresh symbolic retval pinned to 1 (success) in the path
+    constraints (reference util.py:166-173; used by native/cheat-code
+    calls, which always succeed when they return)."""
+    retval = push_unconstrained_ret_val(global_state)
     global_state.world_state.constraints.append(retval == 1)
 
 
 def push_unconstrained_ret_val(global_state):
-    """Push a fresh UNCONSTRAINED call-success flag (reference parity:
-    the call-family empty-callee/unresolvable paths push new_bitvec with
-    no constraint — instructions.py retval pushes — so UncheckedRetval
-    can branch both ways; only native/cheat-code calls pin success via
+    """Push and return a fresh UNCONSTRAINED call-success flag
+    (reference parity: the call-family empty-callee/unresolvable paths
+    push new_bitvec with no constraint, so UncheckedRetval can branch
+    both ways; native/cheat-code calls pin success via
     insert_ret_val)."""
-    global_state.mstate.stack.append(
-        global_state.new_bitvec(
-            "retval_" + str(global_state.get_current_instruction()["address"]),
-            256,
-        )
+    retval = global_state.new_bitvec(
+        "retval_" + str(global_state.get_current_instruction()["address"]),
+        256,
     )
+    global_state.mstate.stack.append(retval)
+    return retval

@@ -21,6 +21,16 @@ from ..support.support_args import args
 log = logging.getLogger(__name__)
 
 
+def reset_analysis_state() -> None:
+    """Reset per-analysis global state (solver session, keccak axioms)
+    between independent contract analyses."""
+    from ..laser.function_managers import keccak_function_manager
+    from ..smt.solver.core import reset_session
+
+    reset_session()
+    keccak_function_manager.reset()
+
+
 class MythrilAnalyzer:
     def __init__(
         self,
@@ -115,6 +125,14 @@ class MythrilAnalyzer:
         execution_info = None
         for contract in self.contracts:
             try:
+                # fresh solver session + keccak axioms per contract:
+                # another contract's clauses and hash conditions only
+                # slow this one down (the reference runs one contract
+                # per process, so its global singletons never face a
+                # sweep). Done here — not in SymExecWrapper — so wrapper
+                # construction stays side-effect-free for live
+                # statespaces (e.g. graph_html after fire_lasers).
+                reset_analysis_state()
                 sym = self._sym_exec(contract, modules, transaction_count)
                 issues = fire_lasers(sym, modules)
                 execution_info = sym.execution_info
