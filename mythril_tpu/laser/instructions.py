@@ -22,22 +22,15 @@ from ..smt import (
     Concat,
     Expression,
     Extract,
-    If,
-    LShR,
     Not,
     Or,
-    UDiv,
     UGE,
-    UGT,
     ULE,
-    ULT,
-    URem,
-    SRem,
     simplify,
     symbol_factory,
 )
 from ..support.support_args import args as global_args
-from . import util
+from . import alu, util
 from .call import (
     SYMBOLIC_CALLDATA_SIZE,
     get_call_data,
@@ -71,8 +64,6 @@ from .transaction import (
 
 log = logging.getLogger(__name__)
 
-TT256 = symbol_factory.BitVecVal(0, 256)
-TT256M1 = symbol_factory.BitVecVal(2**256 - 1, 256)
 
 
 def transfer_ether(
@@ -194,121 +185,81 @@ class Instruction:
 
     @StateTransition()
     def add_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        stack.append(util.pop_bitvec(global_state.mstate)
-                     + util.pop_bitvec(global_state.mstate))
+        state = global_state.mstate
+        state.stack.append(
+            alu.add(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def sub_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        stack.append(util.pop_bitvec(global_state.mstate)
-                     - util.pop_bitvec(global_state.mstate))
+        state = global_state.mstate
+        state.stack.append(
+            alu.sub(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def mul_(self, global_state: GlobalState) -> List[GlobalState]:
-        stack = global_state.mstate.stack
-        stack.append(util.pop_bitvec(global_state.mstate)
-                     * util.pop_bitvec(global_state.mstate))
+        state = global_state.mstate
+        state.stack.append(
+            alu.mul(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def div_(self, global_state: GlobalState) -> List[GlobalState]:
-        op0, op1 = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
+        state = global_state.mstate
+        state.stack.append(
+            alu.div(util.pop_bitvec(state), util.pop_bitvec(state))
         )
-        if op1.value == 0:
-            global_state.mstate.stack.append(
-                symbol_factory.BitVecVal(0, 256)
-            )
-        elif op1.symbolic:
-            global_state.mstate.stack.append(
-                If(op1 == 0, symbol_factory.BitVecVal(0, 256),
-                   UDiv(op0, op1))
-            )
-        else:
-            global_state.mstate.stack.append(UDiv(op0, op1))
         return [global_state]
 
     @StateTransition()
     def sdiv_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1 = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
+        state = global_state.mstate
+        state.stack.append(
+            alu.sdiv(util.pop_bitvec(state), util.pop_bitvec(state))
         )
-        if s1.value == 0:
-            global_state.mstate.stack.append(
-                symbol_factory.BitVecVal(0, 256)
-            )
-        elif s1.symbolic:
-            global_state.mstate.stack.append(
-                If(s1 == 0, symbol_factory.BitVecVal(0, 256), s0 / s1)
-            )
-        else:
-            global_state.mstate.stack.append(s0 / s1)
         return [global_state]
 
     @StateTransition()
     def mod_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1 = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(
-            symbol_factory.BitVecVal(0, 256)
-            if s1.value == 0
-            else If(s1 == 0, symbol_factory.BitVecVal(0, 256),
-                    URem(s0, s1))
+        state = global_state.mstate
+        state.stack.append(
+            alu.mod(util.pop_bitvec(state), util.pop_bitvec(state))
         )
         return [global_state]
 
     @StateTransition()
     def smod_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1 = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        global_state.mstate.stack.append(
-            symbol_factory.BitVecVal(0, 256)
-            if s1.value == 0
-            else If(s1 == 0, symbol_factory.BitVecVal(0, 256),
-                    SRem(s0, s1))
+        state = global_state.mstate
+        state.stack.append(
+            alu.smod(util.pop_bitvec(state), util.pop_bitvec(state))
         )
         return [global_state]
 
     @StateTransition()
     def addmod_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1, s2 = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        # compute over 512 bits to avoid wrap, then reduce
-        z = symbol_factory.BitVecVal(0, 256)
-        s0x = Concat(z, s0)
-        s1x = Concat(z, s1)
-        s2x = Concat(z, s2)
-        total = URem(s0x + s1x, s2x)
-        global_state.mstate.stack.append(
-            If(s2 == 0, symbol_factory.BitVecVal(0, 256),
-               Extract(255, 0, total))
+        state = global_state.mstate
+        state.stack.append(
+            alu.addmod(
+                util.pop_bitvec(state),
+                util.pop_bitvec(state),
+                util.pop_bitvec(state),
+            )
         )
         return [global_state]
 
     @StateTransition()
     def mulmod_(self, global_state: GlobalState) -> List[GlobalState]:
-        s0, s1, s2 = (
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-            util.pop_bitvec(global_state.mstate),
-        )
-        z = symbol_factory.BitVecVal(0, 256)
-        total = URem(Concat(z, s0) * Concat(z, s1), Concat(z, s2))
-        global_state.mstate.stack.append(
-            If(s2 == 0, symbol_factory.BitVecVal(0, 256),
-               Extract(255, 0, total))
+        state = global_state.mstate
+        state.stack.append(
+            alu.mulmod(
+                util.pop_bitvec(state),
+                util.pop_bitvec(state),
+                util.pop_bitvec(state),
+            )
         )
         return [global_state]
 
@@ -316,51 +267,17 @@ class Instruction:
     def exp_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         base, exponent = util.pop_bitvec(state), util.pop_bitvec(state)
-        if not base.symbolic and base.value is not None:
-            b = base.value
-            if b in (0, 1):
-                # 0**e = (e==0), 1**e = 1
-                zero = symbol_factory.BitVecVal(0, 256)
-                one = symbol_factory.BitVecVal(1, 256)
-                result = one if b == 1 else If(exponent == zero, one, zero)
-                state.stack.append(result)
-                return [global_state]
-            if b & (b - 1) == 0:
-                # (2^m)**e == 1 << (m*e): keeps the Solidity
-                # storage-packing idiom (256**k divisors) as shifts the
-                # solver handles cheaply instead of an opaque Power UF.
-                # Guard: for e >= 256 the true result is 0 (m >= 1) and
-                # m*e must not be allowed to wrap mod 2^256.
-                m = b.bit_length() - 1
-                shift = symbol_factory.BitVecVal(m, 256) * exponent
-                result = If(
-                    ULT(exponent, symbol_factory.BitVecVal(256, 256)),
-                    symbol_factory.BitVecVal(1, 256) << shift,
-                    symbol_factory.BitVecVal(0, 256),
-                )
-                state.stack.append(result)
-                return [global_state]
-        exponentiation, constraint = (
-            exponent_function_manager.create_condition(base, exponent)
-        )
-        state.stack.append(exponentiation)
-        global_state.world_state.constraints.append(constraint)
+        result, constraint = alu.exp(base, exponent)
+        state.stack.append(result)
+        if constraint is not None:
+            global_state.world_state.constraints.append(constraint)
         return [global_state]
 
     @StateTransition()
     def signextend_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        s0, s1 = util.pop_bitvec(state), util.pop_bitvec(state)
-        testbit = s0 * symbol_factory.BitVecVal(8, 256) + 7
-        set_testbit = symbol_factory.BitVecVal(1, 256) << testbit
-        sign_bit_set = (s1 & set_testbit) != 0
-        extended = If(
-            sign_bit_set,
-            s1 | (TT256M1 - (set_testbit - 1)),
-            s1 & (set_testbit - 1),
-        )
         state.stack.append(
-            If(ULT(s0, symbol_factory.BitVecVal(32, 256)), extended, s1)
+            alu.signextend(util.pop_bitvec(state), util.pop_bitvec(state))
         )
         return [global_state]
 
@@ -369,148 +286,107 @@ class Instruction:
     @StateTransition()
     def lt_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        exp = ULT(util.pop_bitvec(state), util.pop_bitvec(state))
-        state.stack.append(exp)
+        state.stack.append(
+            alu.lt(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def gt_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        exp = UGT(util.pop_bitvec(state), util.pop_bitvec(state))
-        state.stack.append(exp)
+        state.stack.append(
+            alu.gt(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def slt_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        exp = util.pop_bitvec(state) < util.pop_bitvec(state)
-        state.stack.append(exp)
+        state.stack.append(
+            alu.slt(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def sgt_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        exp = util.pop_bitvec(state) > util.pop_bitvec(state)
-        state.stack.append(exp)
+        state.stack.append(
+            alu.sgt(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def eq_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        op1, op2 = state.stack.pop(), state.stack.pop()
-        if isinstance(op1, Bool):
-            op1 = If(
-                op1,
-                symbol_factory.BitVecVal(1, 256),
-                symbol_factory.BitVecVal(0, 256),
-            )
-        if isinstance(op2, Bool):
-            op2 = If(
-                op2,
-                symbol_factory.BitVecVal(1, 256),
-                symbol_factory.BitVecVal(0, 256),
-            )
-        exp = op1 == op2
-        state.stack.append(exp)
+        state.stack.append(alu.eq(state.stack.pop(), state.stack.pop()))
         return [global_state]
 
     @StateTransition()
     def iszero_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        val = state.stack.pop()
-        exp = Not(val) if isinstance(val, Bool) else val == 0
-        if hasattr(val, "annotations"):
-            exp.annotations = exp.annotations | val.annotations
-        state.stack.append(exp)
+        state.stack.append(alu.iszero(state.stack.pop()))
         return [global_state]
 
     @StateTransition()
     def and_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        op1, op2 = util.pop_bitvec(state), util.pop_bitvec(state)
-        state.stack.append(op1 & op2)
+        state.stack.append(
+            alu.and_(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def or_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        op1, op2 = util.pop_bitvec(state), util.pop_bitvec(state)
-        state.stack.append(op1 | op2)
+        state.stack.append(
+            alu.or_(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def xor_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
         state.stack.append(
-            util.pop_bitvec(state) ^ util.pop_bitvec(state)
+            alu.xor(util.pop_bitvec(state), util.pop_bitvec(state))
         )
         return [global_state]
 
     @StateTransition()
     def not_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        state.stack.append(TT256M1 - util.pop_bitvec(state))
+        state.stack.append(alu.not_(util.pop_bitvec(state)))
         return [global_state]
 
     @StateTransition()
     def byte_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        op0, op1 = util.pop_bitvec(state), util.pop_bitvec(state)
-        if op0.value is not None:
-            if op0.value >= 32:
-                state.stack.append(symbol_factory.BitVecVal(0, 256))
-            else:
-                index = op0.value
-                offset = (31 - index) * 8
-                state.stack.append(
-                    Concat(
-                        symbol_factory.BitVecVal(0, 248),
-                        Extract(offset + 7, offset, op1),
-                    )
-                )
-        else:
-            shifted = LShR(
-                op1,
-                (symbol_factory.BitVecVal(31, 256) - op0)
-                * symbol_factory.BitVecVal(8, 256),
-            )
-            state.stack.append(
-                If(
-                    ULT(op0, symbol_factory.BitVecVal(32, 256)),
-                    shifted & 0xFF,
-                    symbol_factory.BitVecVal(0, 256),
-                )
-            )
+        state.stack.append(
+            alu.byte_op(util.pop_bitvec(state), util.pop_bitvec(state))
+        )
         return [global_state]
 
     @StateTransition()
     def shl_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        shift, value = (
-            util.pop_bitvec(state),
-            util.pop_bitvec(state),
+        state.stack.append(
+            alu.shl(util.pop_bitvec(state), util.pop_bitvec(state))
         )
-        state.stack.append(value << shift)
         return [global_state]
 
     @StateTransition()
     def shr_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        shift, value = (
-            util.pop_bitvec(state),
-            util.pop_bitvec(state),
+        state.stack.append(
+            alu.shr(util.pop_bitvec(state), util.pop_bitvec(state))
         )
-        state.stack.append(LShR(value, shift))
         return [global_state]
 
     @StateTransition()
     def sar_(self, global_state: GlobalState) -> List[GlobalState]:
         state = global_state.mstate
-        shift, value = (
-            util.pop_bitvec(state),
-            util.pop_bitvec(state),
+        state.stack.append(
+            alu.sar(util.pop_bitvec(state), util.pop_bitvec(state))
         )
-        state.stack.append(value >> shift)
         return [global_state]
 
     # -- SHA3 ---------------------------------------------------------------

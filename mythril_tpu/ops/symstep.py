@@ -1,0 +1,870 @@
+"""Symbolic lane stepper: batched *symbolic* EVM execution on device.
+
+This is the symbolic lift of the concrete lane engine (ops/stepper.py) —
+the bridge that makes the TPU the primary execution substrate for `myth
+analyze` workloads (SURVEY.md §7 step 4). Where the reference forks and
+evaluates one `GlobalState` at a time in Python with z3 terms on the stack
+(mythril/laser/ethereum/svm.py:293-337, instructions.py:1520-1636), here N
+paths execute per device step and symbolic values are *handles*:
+
+- every value plane (stack, storage values, env words, calldata size)
+  carries a parallel i32 **sid plane**: 0 = the 8xu32 limbs are the
+  concrete value; >0 = index into the host bridge's object table (a
+  facade BitVec/Bool built at a previous drain); <0 = *provisional* id
+  minted this window, encoding (lane, deferred-record slot);
+- ops over all-concrete operands execute exactly like the concrete
+  stepper; any symbolic operand instead appends a **deferred record**
+  (op, pc, step, three operand sids/values) to the lane's bounded log and
+  pushes a provisional sid. The host drains logs each sync window and
+  builds the same terms the interpreter would have built — via the shared
+  mythril_tpu/laser/alu.py semantics, so divergence is impossible by
+  construction;
+- a symbolic JUMPI **forks the lane**: the parent takes the jump, a copy
+  written into a free slot takes the fall-through, and both append the
+  condition to their path-condition log (the device analog of the
+  reference's two deepcopies + constraint append,
+  instructions.py:1597-1633). Fork slots come from a device-side free
+  list refilled by the host;
+- memory gains a bounded symbolic **overlay log** (offset, len, sid) over
+  the concrete byte plane: aligned 32-byte symbolic store/load pairs (the
+  dominant Solidity scratch-space pattern) resolve on device, partial
+  overlaps park;
+- storage entries carry value sids and a `written` flag; misses against a
+  symbolic base array defer to a select() built at drain time and are
+  cached in the log so repeated loads are device-local;
+- anything the device cannot model *parks* the lane (NEEDS_HOST) with the
+  pc still pointing at the unexecuted instruction: the host engine
+  re-executes that instruction with full hook dispatch, so detector and
+  transaction semantics are exactly the host's. Terminal ops
+  (STOP/RETURN/REVERT/INVALID/SELFDESTRUCT) always park — paths end once,
+  and ending them host-side keeps tx-end signals and issue checks intact.
+
+Gas is the host's [min, max] interval accounting (static opcode costs +
+the quadratic memory-expansion fee of machine_state.calculate_memory_gas),
+so materialized states carry exactly the gas the interpreter would have.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..support.opcodes import ADDRESS, GAS, OPCODES
+from . import bv256
+from .stepper import (
+    ENV_SLOTS,
+    N_ENV,
+    NPOP_TABLE,
+    NPUSH_TABLE,
+    RESULT_CLASSES,
+    RESULT_CLASS_ID,
+    RESULT_CLASS_TABLE,
+    ENV_TABLE,
+    CompiledCode,
+    Status,
+    _onehot_gather,
+    _peek,
+    _scatter_word,
+    _u32_of,
+    bytes_be_to_word,
+    compile_code,
+    word_to_bytes_be,
+)
+
+_OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+# status additions
+DEAD = 7  # free slot (never executed / retired)
+
+GAS_MEMORY = 3
+GAS_MEMORY_QUAD_DENOM = 512
+
+
+def _build_sym_tables():
+    gas_min = np.zeros(256, dtype=np.uint32)
+    gas_max = np.zeros(256, dtype=np.uint32)
+    for name, data in OPCODES.items():
+        byte = data[ADDRESS]
+        gas_min[byte] = data[GAS][0]
+        gas_max[byte] = data[GAS][1]
+
+    executable = np.zeros(256, dtype=bool)
+    deferrable = np.zeros(256, dtype=bool)
+
+    defer_ops = (
+        "ADD MUL SUB DIV SDIV MOD SMOD ADDMOD MULMOD EXP SIGNEXTEND "
+        "LT GT SLT SGT EQ ISZERO AND OR XOR NOT BYTE SHL SHR SAR"
+    ).split()
+    for name in defer_ops:
+        deferrable[_OP[name]] = True
+        executable[_OP[name]] = True
+
+    for name in (
+        "POP MLOAD MSTORE MSTORE8 SLOAD SSTORE JUMP JUMPI JUMPDEST PC "
+        "MSIZE GAS CALLDATALOAD CALLDATASIZE CODESIZE"
+    ).split():
+        executable[_OP[name]] = True
+    for name in ENV_SLOTS:
+        executable[_OP[name]] = True
+    for b in range(0x60, 0xA0):  # PUSH1-32, DUP1-16, SWAP1-16
+        executable[b] = True
+
+    return jnp.asarray(gas_min), jnp.asarray(gas_max), \
+        jnp.asarray(executable), jnp.asarray(deferrable)
+
+
+GAS_MIN_TABLE, GAS_MAX_TABLE, SYM_EXECUTABLE, DEFERRABLE = \
+    _build_sym_tables()
+
+
+class SymLaneState(NamedTuple):
+    """Struct-of-arrays symbolic lane batch. Shapes:
+    N lanes, D stack, M memory bytes, MR memory-overlay records,
+    S storage slots, C calldata bytes, R deferred records, P path conds,
+    F fork-log entries."""
+
+    pc: jnp.ndarray            # (N,) i32 — byte address
+    sp: jnp.ndarray            # (N,) i32
+    depth: jnp.ndarray         # (N,) i32 — JUMPI fork depth (host parity)
+    stack: jnp.ndarray         # (N, D, 8) u32
+    ssid: jnp.ndarray          # (N, D) i32
+    memory: jnp.ndarray        # (N, M) u8
+    msize: jnp.ndarray         # (N,) i32
+    msym: jnp.ndarray          # (N,) i32 — symbolic overlay records live
+    mlog_off: jnp.ndarray      # (N, MR) i32
+    mlog_len: jnp.ndarray      # (N, MR) i32
+    mlog_sid: jnp.ndarray      # (N, MR) i32 (0 = concrete-write marker)
+    mlog_count: jnp.ndarray    # (N,) i32
+    skeys: jnp.ndarray         # (N, S, 8) u32
+    svals: jnp.ndarray         # (N, S, 8) u32
+    sval_sid: jnp.ndarray      # (N, S) i32
+    s_written: jnp.ndarray     # (N, S) i32 (1 = SSTORE, 0 = read cache)
+    scount: jnp.ndarray        # (N,) i32
+    sbase: jnp.ndarray         # (N,) i32 (0 = zero K-array base, else sym)
+    calldata: jnp.ndarray      # (N, C) u8
+    cd_size: jnp.ndarray       # (N,) i32
+    cd_sym: jnp.ndarray        # (N,) i32 (1 = calldata is symbolic)
+    cd_size_sid: jnp.ndarray   # (N,) i32
+    env: jnp.ndarray           # (N, N_ENV, 8) u32
+    env_sid: jnp.ndarray       # (N, N_ENV) i32
+    min_gas: jnp.ndarray       # (N,) u32
+    max_gas: jnp.ndarray       # (N,) u32
+    gas_limit: jnp.ndarray     # (N,) u32
+    status: jnp.ndarray        # (N,) i32
+    steps: jnp.ndarray         # (N,) i32
+    dlog_op: jnp.ndarray       # (N, R) i32
+    dlog_pc: jnp.ndarray       # (N, R) i32
+    dlog_step: jnp.ndarray     # (N, R) i32
+    dlog_sid: jnp.ndarray      # (N, R, 3) i32
+    dlog_val: jnp.ndarray      # (N, R, 3, 8) u32
+    dlog_count: jnp.ndarray    # (N,) i32
+    pclog_sid: jnp.ndarray     # (N, P) i32
+    pclog_neg: jnp.ndarray     # (N, P) i32 (1 = negated side)
+    pclog_count: jnp.ndarray   # (N,) i32
+    flog_parent: jnp.ndarray   # (F,) i32
+    flog_child: jnp.ndarray    # (F,) i32
+    flog_step: jnp.ndarray     # (F,) i32
+    flog_count: jnp.ndarray    # () i32
+    free_slots: jnp.ndarray    # (N,) i32 — stack of free slot indices
+    free_count: jnp.ndarray    # () i32
+    step_no: jnp.ndarray       # () i32 — global step counter
+
+
+MAX_FORKS_PER_STEP = 64
+
+
+def init_sym_lanes(
+    n_lanes: int,
+    stack_depth: int = 64,
+    memory_bytes: int = 4096,
+    mem_records: int = 64,
+    storage_slots: int = 64,
+    calldata_bytes: int = 512,
+    dlog_records: int = 64,
+    pc_records: int = 64,
+    gas_limit: int = 8_000_000,
+) -> SymLaneState:
+    z = jnp.zeros
+    n = n_lanes
+    return SymLaneState(
+        pc=z((n,), jnp.int32),
+        sp=z((n,), jnp.int32),
+        depth=z((n,), jnp.int32),
+        stack=z((n, stack_depth, bv256.NLIMBS), jnp.uint32),
+        ssid=z((n, stack_depth), jnp.int32),
+        memory=z((n, memory_bytes), jnp.uint8),
+        msize=z((n,), jnp.int32),
+        msym=z((n,), jnp.int32),
+        mlog_off=z((n, mem_records), jnp.int32),
+        mlog_len=z((n, mem_records), jnp.int32),
+        mlog_sid=z((n, mem_records), jnp.int32),
+        mlog_count=z((n,), jnp.int32),
+        skeys=z((n, storage_slots, bv256.NLIMBS), jnp.uint32),
+        svals=z((n, storage_slots, bv256.NLIMBS), jnp.uint32),
+        sval_sid=z((n, storage_slots), jnp.int32),
+        s_written=z((n, storage_slots), jnp.int32),
+        scount=z((n,), jnp.int32),
+        sbase=z((n,), jnp.int32),
+        calldata=z((n, calldata_bytes), jnp.uint8),
+        cd_size=z((n,), jnp.int32),
+        cd_sym=z((n,), jnp.int32),
+        cd_size_sid=z((n,), jnp.int32),
+        env=z((n, N_ENV, bv256.NLIMBS), jnp.uint32),
+        env_sid=z((n, N_ENV), jnp.int32),
+        min_gas=z((n,), jnp.uint32),
+        max_gas=z((n,), jnp.uint32),
+        gas_limit=jnp.full((n,), gas_limit, jnp.uint32),
+        status=jnp.full((n,), DEAD, jnp.int32),
+        steps=z((n,), jnp.int32),
+        dlog_op=z((n, dlog_records), jnp.int32),
+        dlog_pc=z((n, dlog_records), jnp.int32),
+        dlog_step=z((n, dlog_records), jnp.int32),
+        dlog_sid=z((n, dlog_records, 3), jnp.int32),
+        dlog_val=z((n, dlog_records, 3, bv256.NLIMBS), jnp.uint32),
+        dlog_count=z((n,), jnp.int32),
+        pclog_sid=z((n, pc_records), jnp.int32),
+        pclog_neg=z((n, pc_records), jnp.int32),
+        pclog_count=z((n,), jnp.int32),
+        flog_parent=z((n,), jnp.int32),
+        flog_child=z((n,), jnp.int32),
+        flog_step=z((n,), jnp.int32),
+        flog_count=jnp.zeros((), jnp.int32),
+        free_slots=jnp.arange(n - 1, -1, -1, dtype=jnp.int32),
+        free_count=jnp.asarray(n, jnp.int32),
+        step_no=jnp.zeros((), jnp.int32),
+    )
+
+
+def _gather_flat(arr, idx):
+    """arr[lane, idx[lane]] for a (N, S) plane via dense one-hot."""
+    size = arr.shape[1]
+    onehot = jnp.arange(size)[None, :] == idx[:, None]
+    return jnp.sum(jnp.where(onehot, arr, 0), axis=1)
+
+
+def _scatter_flat(arr, lane_mask, idx, value):
+    """arr[lane, idx[lane]] = value[lane] where lane_mask (dense)."""
+    size = arr.shape[1]
+    onehot = (jnp.arange(size)[None, :] == idx[:, None]) \
+        & lane_mask[:, None]
+    return jnp.where(onehot, value[:, None], arr)
+
+
+def _peek_sid(ssid, sp, k):
+    return _gather_flat(ssid, jnp.clip(sp - k, 0, ssid.shape[1] - 1))
+
+
+def _mem_fee(old_bytes, new_bytes):
+    """Yellow-paper memory fee delta, mirroring
+    MachineState.calculate_memory_gas (laser/state/machine_state.py)."""
+    ow = (old_bytes // 32).astype(jnp.uint32)
+    nw = (new_bytes // 32).astype(jnp.uint32)
+    old_fee = ow * GAS_MEMORY + (ow * ow) // GAS_MEMORY_QUAD_DENOM
+    new_fee = nw * GAS_MEMORY + (nw * nw) // GAS_MEMORY_QUAD_DENOM
+    return new_fee - old_fee
+
+
+def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
+    """Advance every running lane by one instruction (symbolic mode)."""
+    n, depth_cap, _ = st.stack.shape
+    mem_bytes = st.memory.shape[1]
+    mem_recs = st.mlog_off.shape[1]
+    s_slots = st.skeys.shape[1]
+    d_recs = st.dlog_op.shape[1]
+    p_recs = st.pclog_sid.shape[1]
+    lanes = jnp.arange(n)
+
+    running = st.status == Status.RUNNING
+    pc_c = jnp.clip(st.pc, 0, code.size)
+    op = code.opcode[pc_c]
+    # idle lanes execute JUMPDEST (a supported no-op) to stay masked out
+    op = jnp.where(running, op, _OP["JUMPDEST"]).astype(jnp.int32)
+
+    npop = NPOP_TABLE[op]
+    npush = NPUSH_TABLE[op]
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    dup_n = jnp.where(is_dup, op - 0x7F, 1)
+    swap_n = jnp.where(is_swap, op - 0x8F, 1)
+    eff_pop = jnp.where(is_dup, dup_n, jnp.where(is_swap, swap_n + 1, npop))
+
+    underflow = st.sp < eff_pop
+    overflow = (st.sp - npop + npush) > depth_cap
+
+    a = _peek(st.stack, st.sp, 1)
+    b = _peek(st.stack, st.sp, 2)
+    c = _peek(st.stack, st.sp, 3)
+    sid_a = _peek_sid(st.ssid, st.sp, 1)
+    sid_b = _peek_sid(st.ssid, st.sp, 2)
+    sid_c = _peek_sid(st.ssid, st.sp, 3)
+    sym_a = sid_a != 0
+    sym_b = sid_b != 0
+    sym_c = sid_c != 0
+    any_sym = (
+        ((npop >= 1) & sym_a)
+        | ((npop >= 2) & sym_b)
+        | ((npop >= 3) & sym_c)
+    )
+
+    zero_w = jnp.zeros_like(a)
+    zero_b = jnp.zeros_like(running)
+    zero_i = jnp.zeros_like(st.pc)
+
+    # ---- opcode groups ----------------------------------------------------
+    is_mload = op == _OP["MLOAD"]
+    is_mstore = op == _OP["MSTORE"]
+    is_mstore8 = op == _OP["MSTORE8"]
+    is_sload = op == _OP["SLOAD"]
+    is_sstore = op == _OP["SSTORE"]
+    is_cdl = op == _OP["CALLDATALOAD"]
+    is_jump = op == _OP["JUMP"]
+    is_jumpi = op == _OP["JUMPI"]
+    is_exp = op == _OP["EXP"]
+
+    # ---- memory offsets / fees (needed before park resolution) -----------
+    mem_off_u32, mem_off_hi = _u32_of(a)
+    mem_big = mem_off_hi | (mem_off_u32 >= jnp.uint32(1 << 30))
+    mem_off = jnp.where(mem_big, 0, mem_off_u32).astype(jnp.int32)
+    mem_ops = is_mload | is_mstore | is_mstore8
+    acc_len = jnp.where(is_mstore8, 1, 32)
+    mem_end = mem_off + acc_len
+    mem_oob = mem_ops & ~sym_a & (mem_big | (mem_end > mem_bytes))
+    new_msize = jnp.where(
+        mem_ops & ~sym_a & ~mem_oob,
+        jnp.maximum(st.msize, ((mem_end + 31) // 32) * 32),
+        st.msize,
+    )
+    mem_fee = _mem_fee(st.msize.astype(jnp.uint32),
+                       new_msize.astype(jnp.uint32))
+
+    # ---- jump destination decode ------------------------------------------
+    dest_u32, dest_hi = _u32_of(a)
+    dest_small = ~dest_hi & (dest_u32 < jnp.uint32(code.size))
+    dest = jnp.where(dest_small, dest_u32, 0).astype(jnp.int32)
+    dest_ok = dest_small & code.is_jumpdest[jnp.clip(dest, 0, code.size)]
+    jumpi_taken_conc = ~sym_b & ~bv256.is_zero(b)
+
+    # ---- EXP purity: device defers only 0/1/2^m concrete bases ------------
+    a_popcount = jnp.sum(
+        lax.population_count(a.astype(jnp.uint32)), axis=-1
+    )
+    exp_pure = ~sym_a & (a_popcount <= 1)
+
+    # ---- memory overlay decisions (MLOAD) ---------------------------------
+    rec_ids = jnp.arange(mem_recs)[None, :]
+    live_rec = rec_ids < st.mlog_count[:, None]
+    ov = (
+        live_rec
+        & (st.mlog_off < mem_end[:, None])
+        & ((st.mlog_off + st.mlog_len) > mem_off[:, None])
+    )
+    ov_sym = ov & (st.mlog_sid != 0)
+    last_any = jnp.max(jnp.where(ov, rec_ids + 1, 0), axis=1) - 1
+    last_sym = jnp.max(jnp.where(ov_sym, rec_ids + 1, 0), axis=1) - 1
+    la_c = jnp.clip(last_any, 0, mem_recs - 1)
+    la_off = _gather_flat(st.mlog_off, la_c)
+    la_len = _gather_flat(st.mlog_len, la_c)
+    la_sid = _gather_flat(st.mlog_sid, la_c)
+    no_sym_ov = last_sym < 0
+    top_sym_exact = (
+        (last_any == last_sym) & (last_sym >= 0)
+        & (la_off == mem_off) & (la_len == 32)
+    )
+    top_conc_cover = (
+        (last_any >= 0) & (la_sid == 0)
+        & (la_off <= mem_off) & ((la_off + la_len) >= mem_end)
+    )
+    mload_sym_sid = jnp.where(top_sym_exact, la_sid, 0)
+    mload_conc_ok = no_sym_ov | top_conc_cover
+    mload_park = is_mload & ~sym_a & ~mem_oob \
+        & ~(top_sym_exact | mload_conc_ok)
+
+    # MSTORE/MSTORE8 record requirements
+    sym_store_val = is_mstore & sym_b
+    need_mrec = (is_mstore | is_mstore8) & (sym_store_val | (st.msym > 0))
+    mlog_full = need_mrec & (st.mlog_count >= mem_recs)
+
+    # ---- storage decisions -------------------------------------------------
+    slot_ids = jnp.arange(s_slots)[None, :]
+    key_match = jnp.all(st.skeys == a[:, None, :], axis=-1) \
+        & (slot_ids < st.scount[:, None])
+    match_score = jnp.where(key_match, slot_ids + 1, 0)
+    best = jnp.max(match_score, axis=1)
+    s_found = best > 0
+    s_idx = jnp.clip(best - 1, 0, s_slots - 1)
+    sload_hit_val = _onehot_gather(st.svals, s_idx)
+    sload_hit_sid = _gather_flat(st.sval_sid, s_idx)
+    sload_miss_sym = is_sload & ~sym_a & ~s_found & (st.sbase != 0)
+    storage_insert = (
+        (is_sstore & ~sym_a & ~s_found) | sload_miss_sym
+    )
+    storage_full = storage_insert & (st.scount >= s_slots)
+
+    # ---- calldata ---------------------------------------------------------
+    cd_bytes = st.calldata.shape[1]
+    cd_symbolic = st.cd_sym != 0
+    cdl_defer = is_cdl & cd_symbolic
+    cd_off_u32, cd_off_hi = _u32_of(a)
+    cd_big = cd_off_hi | (cd_off_u32 >= jnp.uint32(1 << 30))
+    cd_off = jnp.where(cd_big, cd_bytes, cd_off_u32).astype(jnp.int32)
+    cd_oob = is_cdl & ~cd_symbolic & ~sym_a & (
+        (cd_off < st.cd_size) & (cd_off + 32 > cd_bytes)
+    )
+
+    # ---- deferral decision ------------------------------------------------
+    defer = DEFERRABLE[op] & any_sym
+    defer = defer & ~(is_exp & ~exp_pure)  # impure EXP parks below
+    defer = defer | cdl_defer | sload_miss_sym
+    dlog_full = defer & (st.dlog_count >= d_recs)
+
+    # ---- gas --------------------------------------------------------------
+    gmin = GAS_MIN_TABLE[op] + mem_fee
+    gmax = GAS_MAX_TABLE[op] + mem_fee
+    min_gas_after = st.min_gas + gmin
+    oog = min_gas_after > st.gas_limit
+
+    # ---- park resolution (everything except fork capacity) ----------------
+    park0 = (
+        ~SYM_EXECUTABLE[op]
+        | underflow
+        | overflow
+        | oog
+        | dlog_full
+        | (is_exp & any_sym & ~exp_pure)
+        # memory
+        | (mem_ops & sym_a)                  # symbolic offset
+        | (is_mstore8 & sym_b)               # symbolic byte value
+        | mem_oob
+        | mload_park
+        | mlog_full
+        # storage
+        | ((is_sload | is_sstore) & sym_a)   # symbolic key
+        | storage_full
+        # calldata
+        | (is_cdl & ~cd_symbolic & sym_a)
+        | cd_oob
+        # control flow
+        | (is_jump & (sym_a | ~dest_ok))
+        | (is_jumpi & ~sym_b & jumpi_taken_conc & ~dest_ok)
+        | (is_jumpi & sym_b & (sym_a | ~dest_ok))
+    )
+
+    # ---- fork request / slot allocation (after park0 so capacity gaps
+    # never orphan a fork whose parent already committed to jumping) --------
+    fork_want = running & is_jumpi & sym_b & ~sym_a & dest_ok & ~park0
+    pclog_full_f = fork_want & (st.pclog_count >= p_recs)
+    fork_req = fork_want & ~pclog_full_f
+    forder = jnp.cumsum(fork_req.astype(jnp.int32)) - 1
+    navail = jnp.minimum(st.free_count, MAX_FORKS_PER_STEP)
+    flog_room = st.flog_parent.shape[0] - st.flog_count
+    navail = jnp.minimum(navail, flog_room)
+    fork_can = fork_req & (forder < navail)
+    fork_nocap = (fork_req & ~fork_can) | pclog_full_f
+
+    park = park0 | fork_nocap
+    ok = running & ~park
+    defer = defer & ok
+    fork_can = fork_can & ok
+
+    # ---- concrete ALU families (gated; only lanes with all-concrete
+    # operands consume these results) ---------------------------------------
+    live_alu = ok & ~defer
+
+    add_r = bv256.add(a, b)
+    sub_r = bv256.sub(a, b)
+    and_r = a & b
+    or_r = a | b
+    xor_r = a ^ b
+    not_r = ~a
+    iszero_r = bv256.bool_to_word(bv256.is_zero(a))
+    lt_r = bv256.bool_to_word(bv256.ult(a, b))
+    gt_r = bv256.bool_to_word(bv256.ugt(a, b))
+    slt_r = bv256.bool_to_word(bv256.slt(a, b))
+    sgt_r = bv256.bool_to_word(bv256.sgt(a, b))
+    eq_r = bv256.bool_to_word(bv256.eq(a, b))
+
+    shift_ops = (
+        (op == _OP["BYTE"]) | (op == _OP["SHL"]) | (op == _OP["SHR"])
+        | (op == _OP["SAR"]) | (op == _OP["SIGNEXTEND"])
+    )
+    byte_r, shl_r, shr_r, sar_r, sext_r = lax.cond(
+        jnp.any(live_alu & shift_ops),
+        lambda: (
+            bv256.byte_op(a, b),
+            bv256.shl(b, a),
+            bv256.shr(b, a),
+            bv256.sar(b, a),
+            bv256.signextend(a, b),
+        ),
+        lambda: (zero_w, zero_w, zero_w, zero_w, zero_w),
+    )
+
+    mul_r = lax.cond(
+        jnp.any(live_alu & (op == _OP["MUL"])),
+        lambda: bv256.mul(a, b),
+        lambda: zero_w,
+    )
+
+    div_ops = (
+        (op == _OP["DIV"]) | (op == _OP["SDIV"])
+        | (op == _OP["MOD"]) | (op == _OP["SMOD"])
+    )
+
+    def _div_all():
+        q, r = bv256.divmod_u(a, b)
+        sa, sb = bv256.sign_bit(a), bv256.sign_bit(b)
+        aa = jnp.where(sa[..., None], bv256.neg(a), a)
+        ab = jnp.where(sb[..., None], bv256.neg(b), b)
+        sq, sr = bv256.divmod_u(aa, ab)
+        sdiv_r = jnp.where((sa ^ sb)[..., None], bv256.neg(sq), sq)
+        smod_r = jnp.where(sa[..., None], bv256.neg(sr), sr)
+        return q, r, sdiv_r.astype(jnp.uint32), smod_r.astype(jnp.uint32)
+
+    div_r, mod_r, sdiv_r, smod_r = lax.cond(
+        jnp.any(live_alu & div_ops),
+        _div_all,
+        lambda: (zero_w, zero_w, zero_w, zero_w),
+    )
+
+    mod2_ops = (op == _OP["ADDMOD"]) | (op == _OP["MULMOD"])
+    addmod_r, mulmod_r = lax.cond(
+        jnp.any(live_alu & mod2_ops),
+        lambda: (bv256.addmod(a, b, c), bv256.mulmod(a, b, c)),
+        lambda: (zero_w, zero_w),
+    )
+
+    exp_r = lax.cond(
+        jnp.any(live_alu & is_exp),
+        lambda: bv256.exp(a, b),
+        lambda: zero_w,
+    )
+
+    # ---- memory execution -------------------------------------------------
+    def _memory_block():
+        byte_idx = mem_off[:, None] + jnp.arange(32)[None, :]
+        byte_idx_c = jnp.clip(byte_idx, 0, mem_bytes - 1)
+        mem_read = jnp.take_along_axis(st.memory, byte_idx_c, axis=1)
+        mload = bytes_be_to_word(mem_read)
+
+        store_bytes = word_to_bytes_be(b)
+        do_mstore = ok & is_mstore & ~sym_b
+        scatter_idx = jnp.where(do_mstore[:, None], byte_idx, mem_bytes)
+        mem = st.memory.at[lanes[:, None], scatter_idx].set(
+            store_bytes, mode="drop"
+        )
+        do_mstore8 = ok & is_mstore8
+        b8 = (b[..., 0] & 0xFF).astype(jnp.uint8)
+        idx8 = jnp.where(do_mstore8, mem_off, mem_bytes)
+        mem = mem.at[lanes, idx8].set(b8, mode="drop")
+
+        # overlay records
+        do_rec = ok & need_mrec
+        rec_pos = jnp.clip(st.mlog_count, 0, mem_recs - 1)
+        rec_sid = jnp.where(sym_store_val, sid_b, 0)
+        mlog_off_n = _scatter_flat(st.mlog_off, do_rec, rec_pos, mem_off)
+        mlog_len_n = _scatter_flat(st.mlog_len, do_rec, rec_pos, acc_len)
+        mlog_sid_n = _scatter_flat(st.mlog_sid, do_rec, rec_pos, rec_sid)
+        mlog_count_n = jnp.where(do_rec, st.mlog_count + 1,
+                                 st.mlog_count)
+        msym_n = jnp.where(ok & sym_store_val, st.msym + 1, st.msym)
+        return (mem, mload, mlog_off_n, mlog_len_n, mlog_sid_n,
+                mlog_count_n, msym_n)
+
+    (memory, mload_r, mlog_off2, mlog_len2, mlog_sid2, mlog_count2,
+     msym2) = lax.cond(
+        jnp.any(ok & mem_ops),
+        _memory_block,
+        lambda: (st.memory, zero_w, st.mlog_off, st.mlog_len,
+                 st.mlog_sid, st.mlog_count, st.msym),
+    )
+    msize2 = jnp.where(ok & mem_ops, new_msize, st.msize)
+    msize_r = bv256.from_u32(msize2.astype(jnp.uint32))
+
+    # ---- storage execution ------------------------------------------------
+    def _storage_block():
+        # value pushed by SLOAD: hit -> log value; miss+zero base -> 0;
+        # miss+sym base -> provisional (sid handled in sid select)
+        sload_v = jnp.where(s_found[:, None], sload_hit_val, 0) \
+            .astype(jnp.uint32)
+
+        ins_pos = jnp.where(s_found, s_idx, st.scount)
+        pos_c = jnp.clip(ins_pos, 0, s_slots - 1)
+        do_sstore = ok & is_sstore
+        do_cache = ok & sload_miss_sym
+        do_write = do_sstore | do_cache
+        new_key = a
+        new_val = jnp.where(do_sstore[:, None], b, zero_w)
+        new_sid = jnp.where(do_sstore, sid_b, prov_id)
+        new_written = jnp.where(do_sstore, 1, 0)
+        sk = _scatter_word(st.skeys, do_write, pos_c, new_key)
+        sv = _scatter_word(st.svals, do_write, pos_c, new_val)
+        ssd = _scatter_flat(st.sval_sid, do_write, pos_c, new_sid)
+        # an SSTORE over a read-cache slot must mark it written; a cache
+        # insert never clears a written flag (cache only fires on miss)
+        swr = _scatter_flat(
+            st.s_written, do_write, pos_c,
+            jnp.maximum(new_written, _gather_flat(st.s_written, pos_c)),
+        )
+        sc = jnp.where(do_write & ~s_found, st.scount + 1, st.scount)
+        return sk, sv, ssd, swr, sc, sload_v
+
+    # provisional id for this step's deferred record (used by storage
+    # cache insertion and the result sid select)
+    prov_id = -(lanes * d_recs + jnp.clip(st.dlog_count, 0, d_recs - 1)
+                + 1)
+
+    skeys2, svals2, sval_sid2, s_written2, scount2, sload_r = lax.cond(
+        jnp.any(ok & (is_sload | is_sstore)),
+        _storage_block,
+        lambda: (st.skeys, st.svals, st.sval_sid, st.s_written,
+                 st.scount, zero_w),
+    )
+
+    # ---- calldata execution (concrete path) -------------------------------
+    def _calldata_block():
+        cd_idx = cd_off[:, None] + jnp.arange(32)[None, :]
+        cd_valid = (cd_idx < st.cd_size[:, None]) & (cd_idx < cd_bytes)
+        cd_read = jnp.take_along_axis(
+            st.calldata, jnp.clip(cd_idx, 0, cd_bytes - 1), axis=1
+        )
+        return bytes_be_to_word(jnp.where(cd_valid, cd_read, 0))
+
+    cdl_r = lax.cond(
+        jnp.any(ok & is_cdl & ~cd_symbolic),
+        _calldata_block,
+        lambda: zero_w,
+    )
+
+    # ---- env / misc results ----------------------------------------------
+    env_idx = ENV_TABLE[op]
+    env_r = _onehot_gather(st.env, jnp.clip(env_idx, 0, N_ENV - 1))
+    env_sid_r = _gather_flat(st.env_sid, jnp.clip(env_idx, 0, N_ENV - 1))
+    pc_r = bv256.from_u32(st.pc.astype(jnp.uint32))
+    # GAS pushes the concrete block gas limit (host parity: gas_ pushes
+    # mstate.gas_limit, laser/instructions.py)
+    gas_r = bv256.from_u32(st.gas_limit)
+    cds_r = bv256.from_u32(st.cd_size.astype(jnp.uint32))
+    codesize_r = bv256.from_u32(jnp.full((n,), code.size, jnp.uint32))
+    push_r = code.push_value[pc_c]
+    dup_r = _peek(st.stack, st.sp, dup_n)
+    dup_sid = _peek_sid(st.ssid, st.sp, dup_n)
+
+    # ---- result select ----------------------------------------------------
+    cases = (
+        zero_w, add_r, mul_r, sub_r, div_r, sdiv_r, mod_r, smod_r,
+        addmod_r, mulmod_r, exp_r, sext_r, lt_r, gt_r, slt_r, sgt_r,
+        eq_r, iszero_r, and_r, or_r, xor_r, not_r, byte_r, shl_r,
+        shr_r, sar_r, mload_r, sload_r, pc_r, msize_r, gas_r, cdl_r,
+        cds_r, codesize_r, env_r, push_r, dup_r,
+    )
+    assert len(cases) == len(RESULT_CLASSES)
+    which = jnp.broadcast_to(
+        RESULT_CLASS_TABLE[op][:, None], (n, bv256.NLIMBS)
+    )
+    result = lax.select_n(which, *cases)
+    result = jnp.where(defer[:, None], 0, result)
+
+    # result sid: deferred -> provisional; else op-specific symbolic
+    # passthroughs; else 0 (concrete)
+    result_sid = jnp.where(defer, prov_id, 0)
+    result_sid = jnp.where(
+        ~defer & (RESULT_CLASS_TABLE[op] == RESULT_CLASS_ID["ENV"]),
+        env_sid_r, result_sid)
+    result_sid = jnp.where(
+        ~defer & (op == _OP["CALLDATASIZE"]), st.cd_size_sid, result_sid)
+    result_sid = jnp.where(~defer & is_dup, dup_sid, result_sid)
+    result_sid = jnp.where(
+        ~defer & is_mload, mload_sym_sid, result_sid)
+    result_sid = jnp.where(
+        ~defer & is_sload & s_found, sload_hit_sid, result_sid)
+
+    # ---- stack updates ----------------------------------------------------
+    new_sp = st.sp - npop + npush
+    do_push = ok & (npush == 1)
+    push_idx = jnp.clip(new_sp - 1, 0, depth_cap - 1)
+    stack = _scatter_word(st.stack, do_push, push_idx, result)
+    ssid = _scatter_flat(st.ssid, do_push, push_idx, result_sid)
+
+    do_swap = ok & is_swap
+    top_idx = jnp.clip(st.sp - 1, 0, depth_cap - 1)
+    swap_idx = jnp.clip(st.sp - 1 - swap_n, 0, depth_cap - 1)
+    swap_val = _peek(st.stack, st.sp, swap_n + 1)
+    swap_sid = _peek_sid(st.ssid, st.sp, swap_n + 1)
+    stack = _scatter_word(stack, do_swap, top_idx, swap_val)
+    stack = _scatter_word(stack, do_swap, swap_idx, a)
+    ssid = _scatter_flat(ssid, do_swap, top_idx, swap_sid)
+    ssid = _scatter_flat(ssid, do_swap, swap_idx, sid_a)
+
+    # ---- deferred-record append -------------------------------------------
+    def _dlog_append():
+        pos = jnp.clip(st.dlog_count, 0, d_recs - 1)
+        dop = _scatter_flat(st.dlog_op, defer, pos, op)
+        dpc = _scatter_flat(st.dlog_pc, defer, pos, st.pc)
+        dstep = _scatter_flat(
+            st.dlog_step, defer, pos,
+            jnp.full((n,), st.step_no, jnp.int32))
+        sids = jnp.stack([sid_a, sid_b, sid_c], axis=-1)  # (N, 3)
+        vals = jnp.stack([a, b, c], axis=1)               # (N, 3, 8)
+        onehot = (
+            (jnp.arange(d_recs)[None, :] == pos[:, None])
+            & defer[:, None]
+        )
+        dsid = jnp.where(onehot[:, :, None], sids[:, None, :],
+                         st.dlog_sid)
+        dval = jnp.where(onehot[:, :, None, None], vals[:, None, :, :],
+                         st.dlog_val)
+        dcount = jnp.where(defer, st.dlog_count + 1, st.dlog_count)
+        return dop, dpc, dstep, dsid, dval, dcount
+
+    dlog_op2, dlog_pc2, dlog_step2, dlog_sid2, dlog_val2, dlog_count2 = \
+        lax.cond(
+            jnp.any(defer),
+            _dlog_append,
+            lambda: (st.dlog_op, st.dlog_pc, st.dlog_step, st.dlog_sid,
+                     st.dlog_val, st.dlog_count),
+        )
+
+    # ---- control flow -----------------------------------------------------
+    next_pc = code.next_pc[pc_c]
+    new_pc = next_pc
+    new_pc = jnp.where(is_jump, dest, new_pc)
+    new_pc = jnp.where(is_jumpi & ~sym_b & jumpi_taken_conc, dest, new_pc)
+    # symbolic JUMPI: parent takes the jump; the forked child (below)
+    # takes the fall-through
+    new_pc = jnp.where(fork_can, dest, new_pc)
+
+    new_depth = st.depth + (ok & is_jumpi).astype(jnp.int32)
+
+    # ---- path-condition append (parent side: condition holds) -------------
+    def _pclog_append():
+        pos = jnp.clip(st.pclog_count, 0, p_recs - 1)
+        psid = _scatter_flat(st.pclog_sid, fork_can, pos, sid_b)
+        pneg = _scatter_flat(st.pclog_neg, fork_can, pos, zero_i)
+        pcount = jnp.where(fork_can, st.pclog_count + 1, st.pclog_count)
+        return psid, pneg, pcount
+
+    pclog_sid2, pclog_neg2, pclog_count2 = lax.cond(
+        jnp.any(fork_can),
+        _pclog_append,
+        lambda: (st.pclog_sid, st.pclog_neg, st.pclog_count),
+    )
+
+    # ---- gas / status / bookkeeping ---------------------------------------
+    min_gas = jnp.where(ok, st.min_gas + gmin, st.min_gas)
+    max_gas = jnp.where(ok, st.max_gas + gmax, st.max_gas)
+    status = jnp.where(running & park, Status.NEEDS_HOST, st.status)
+
+    out = st._replace(
+        pc=jnp.where(ok, new_pc, st.pc),
+        sp=jnp.where(ok, new_sp, st.sp),
+        depth=new_depth,
+        stack=stack,
+        ssid=ssid,
+        memory=memory,
+        msize=msize2,
+        msym=msym2,
+        mlog_off=mlog_off2,
+        mlog_len=mlog_len2,
+        mlog_sid=mlog_sid2,
+        mlog_count=mlog_count2,
+        skeys=skeys2,
+        svals=svals2,
+        sval_sid=sval_sid2,
+        s_written=s_written2,
+        scount=scount2,
+        calldata=st.calldata,
+        min_gas=min_gas,
+        max_gas=max_gas,
+        status=status,
+        steps=st.steps + ok.astype(jnp.int32),
+        dlog_op=dlog_op2,
+        dlog_pc=dlog_pc2,
+        dlog_step=dlog_step2,
+        dlog_sid=dlog_sid2,
+        dlog_val=dlog_val2,
+        dlog_count=dlog_count2,
+        pclog_sid=pclog_sid2,
+        pclog_neg=pclog_neg2,
+        pclog_count=pclog_count2,
+        step_no=st.step_no + 1,
+    )
+
+    # ---- forks ------------------------------------------------------------
+    def _do_forks(s: SymLaneState) -> SymLaneState:
+        maxf = MAX_FORKS_PER_STEP
+        fslot = jnp.arange(maxf)
+        # rows of forking parents, scattered by fork order
+        parent_rows = jnp.full((maxf,), n, jnp.int32)
+        parent_rows = parent_rows.at[
+            jnp.where(fork_can, forder, maxf)
+        ].set(jnp.where(fork_can, lanes, n).astype(jnp.int32),
+              mode="drop")
+        nf = jnp.sum(fork_can.astype(jnp.int32))
+        valid = fslot < nf
+        # pop child slots from the free stack top
+        child_idx = jnp.clip(s.free_count - 1 - fslot, 0, n - 1)
+        child_rows = jnp.where(valid, s.free_slots[child_idx], n)
+        parent_c = jnp.clip(parent_rows, 0, n - 1)
+
+        # fields whose leading axis is NOT the lane axis (fork/free-slot
+        # bookkeeping) must not be row-copied
+        no_copy = {"flog_parent", "flog_child", "flog_step",
+                   "flog_count", "free_slots", "free_count", "step_no"}
+
+        def copy_rows(name, x):
+            if name in no_copy or x.ndim == 0 or x.shape[0] != n:
+                return x
+            return x.at[child_rows].set(x[parent_c], mode="drop")
+
+        s2 = SymLaneState(
+            **{f: copy_rows(f, getattr(s, f)) for f in s._fields}
+        )
+        # child diverges: fall-through pc, negated path condition
+        fall_pc = next_pc[parent_c]
+        s2 = s2._replace(
+            pc=s2.pc.at[child_rows].set(fall_pc, mode="drop"),
+            pclog_neg=s2.pclog_neg.at[
+                child_rows,
+                jnp.clip(s2.pclog_count[parent_c] - 1, 0, p_recs - 1),
+            ].set(1, mode="drop"),
+            # the child minted no deferred records of its own
+            dlog_count=s2.dlog_count.at[child_rows].set(0, mode="drop"),
+            flog_parent=s2.flog_parent.at[
+                jnp.where(valid, s.flog_count + fslot, n)
+            ].set(parent_rows, mode="drop"),
+            flog_child=s2.flog_child.at[
+                jnp.where(valid, s.flog_count + fslot, n)
+            ].set(child_rows, mode="drop"),
+            flog_step=s2.flog_step.at[
+                jnp.where(valid, s.flog_count + fslot, n)
+            ].set(jnp.full((maxf,), st.step_no, jnp.int32),
+                  mode="drop"),
+            flog_count=s.flog_count + nf,
+            free_count=s.free_count - nf,
+        )
+        return s2
+
+    out = lax.cond(jnp.any(fork_can), _do_forks, lambda s: s, out)
+    return out
+
+
+def sym_run(code: CompiledCode, st: SymLaneState,
+            max_steps: int) -> SymLaneState:
+    """Run up to max_steps (one sync window). max_steps must not exceed
+    the deferred-log capacity (one record per lane per step)."""
+
+    def cond(carry):
+        s, i = carry
+        return (i < max_steps) & jnp.any(s.status == Status.RUNNING)
+
+    def body(carry):
+        s, i = carry
+        return sym_step(code, s), i + 1
+
+    final, _ = lax.while_loop(cond, body, (st, jnp.int32(0)))
+    return final
+
+
+sym_run_jit = jax.jit(sym_run, static_argnums=(2,), donate_argnums=(1,))
