@@ -139,7 +139,24 @@ class SymExecWrapper:
         plugin_loader.add_args(
             "call-depth-limit", call_depth_limit=args.call_depth_limit
         )
-        if not disable_dependency_pruning:
+        # the dependency pruner's per-basic-block maps are built from
+        # SLOAD/SSTORE/JUMP hooks the lane engine would bypass; it is a
+        # prune-only optimization, so it is dropped when the lane engine
+        # will actually run — but kept when a selected module hooks
+        # JUMPI, which makes the lane sweep bail out anyway
+        # (svm._lane_engine_sweep) and pruning is all the help we get
+        lane_engine_active = bool(args.tpu_lanes)
+        if lane_engine_active and run_analysis_modules:
+            cb_modules = ModuleLoader().get_detection_modules(
+                EntryPoint.CALLBACK, modules
+            )
+            if any(
+                "JUMPI" in (m.pre_hooks or [])
+                or "JUMPI" in (m.post_hooks or [])
+                for m in cb_modules
+            ):
+                lane_engine_active = False
+        if not disable_dependency_pruning and not lane_engine_active:
             plugin_loader.load(DependencyPrunerBuilder())
         plugin_loader.instrument_virtual_machine(self.laser, None)
 

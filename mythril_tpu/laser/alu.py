@@ -29,6 +29,7 @@ from ..smt import (
     ULT,
     UGT,
     URem,
+    simplify,
     symbol_factory,
 )
 from .function_managers import exponent_function_manager
@@ -42,11 +43,14 @@ def _val(v: int) -> BitVec:
 
 def to_bitvec(item: Union[int, BitVec, Bool]) -> BitVec:
     """The pop-coercion applied by util.pop_bitvec (minus the stack pop):
-    Bool -> If(b, 1, 0), int -> BitVecVal."""
+    Bool -> If(b, 1, 0), int -> BitVecVal, BitVec -> simplified in
+    place. util.pop_bitvec delegates here so the interpreter and the
+    lane-drain resolver coerce identically."""
     if isinstance(item, Bool):
         return If(item, _val(1), _val(0))
     if isinstance(item, int):
         return _val(item)
+    item.raw = simplify(item).raw
     return item
 
 

@@ -1003,6 +1003,9 @@ class Instruction:
             new_state.mstate.depth += 1
             new_state.mstate.pc += 1
             new_state.world_state.constraints.append(negated)
+            # manage_cfg labels the CFG edge with this (trivially-true
+            # conditions are not kept in the constraint list)
+            new_state.branch_condition = negated
             states.append(new_state)
         else:
             log.debug("Pruned unreachable states.")
@@ -1021,6 +1024,7 @@ class Instruction:
             new_state.mstate.depth += 1
             new_state.mstate.pc = index
             new_state.world_state.constraints.append(condi)
+            new_state.branch_condition = condi
             states.append(new_state)
         return states
 

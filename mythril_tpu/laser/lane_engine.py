@@ -1,0 +1,718 @@
+"""Lane engine bridge: host side of the symbolic lane stepper.
+
+Seeds device lanes from host `GlobalState`s at transaction entry, runs
+sync windows of `ops/symstep.sym_run`, drains the device's deferred-op /
+path-condition / fork logs back into facade terms, and materializes parked
+lanes as host `GlobalState`s positioned at the instruction the device
+could not execute. The host engine (svm.py) remains the semantic
+authority: CALL/CREATE/SHA3/terminal opcodes and every detector hook run
+host-side on the materialized states.
+
+Parity contract (why this cannot diverge from the interpreter):
+- deferred ALU records resolve through mythril_tpu/laser/alu.py — the
+  same functions the instruction handlers call;
+- CALLDATALOAD resolves through the transaction's own calldata object
+  (state/calldata.py get_word_at), SLOAD through the same select+simplify
+  the Storage class performs (state/account.py:37-67);
+- JUMPI conditions build exactly the condi/negated pair of the jumpi_
+  handler (instructions.py), including trivial-falsity pruning;
+- materialized memory reproduces the byte-granular int/Extract layout of
+  state/memory.py write_word_at;
+- gas is the device's [min,max] interval added onto the seed state's
+  counters, matching StateTransition accumulation.
+
+The object table maps device sids (>0) to facade BitVec/Bool wrappers.
+Provisional (negative) sids minted on device encode (lane, record-slot)
+and are rewritten to table ids at each drain.
+"""
+
+import logging
+from collections import deque
+from copy import deepcopy
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops import bv256, symstep
+from ..ops.stepper import Status, compile_code
+from ..ops.symstep import DEAD, SymLaneState
+from ..smt import (
+    BitVec, Bool, Extract, If, Not, simplify, symbol_factory,
+)
+from ..smt import terms as T
+from . import alu
+from .state.global_state import GlobalState
+from .state.calldata import ConcreteCalldata
+
+log = logging.getLogger(__name__)
+
+_OPN = {}  # opcode byte -> name, filled below
+from ..support.opcodes import ADDRESS, OPCODES  # noqa: E402
+
+for _name, _data in OPCODES.items():
+    _OPN[_data[ADDRESS]] = _name
+_OPB = {v: k for k, v in _OPN.items()}
+
+
+class ObjectTable:
+    """sid (>0) -> facade object (BitVec or Bool)."""
+
+    def __init__(self):
+        self._objs: List = [None]
+
+    def add(self, obj) -> int:
+        self._objs.append(obj)
+        return len(self._objs) - 1
+
+    def __getitem__(self, sid: int):
+        return self._objs[sid]
+
+    def __len__(self):
+        return len(self._objs)
+
+
+class LaneCtx:
+    """Host context of one device lane: the pristine entry state it was
+    seeded from plus the path conditions accumulated through drains."""
+
+    __slots__ = ("template", "conds", "addr2idx", "storage_seed_raw",
+                 "calldata", "gas0_min", "gas0_max")
+
+    def __init__(self, template, addr2idx, storage_seed_raw, calldata,
+                 gas0_min, gas0_max):
+        self.template = template
+        self.conds: List[Bool] = []
+        self.addr2idx = addr2idx
+        self.storage_seed_raw = storage_seed_raw
+        self.calldata = calldata
+        self.gas0_min = gas0_min
+        self.gas0_max = gas0_max
+
+    def clone(self) -> "LaneCtx":
+        c = LaneCtx(self.template, self.addr2idx, self.storage_seed_raw,
+                    self.calldata, self.gas0_min, self.gas0_max)
+        c.conds = list(self.conds)
+        return c
+
+
+def _bv_val(v: int) -> BitVec:
+    return symbol_factory.BitVecVal(v, 256)
+
+
+def _limbs_int(limbs) -> int:
+    return bv256.limbs_to_int(np.asarray(limbs))
+
+
+def code_to_bytes(code_obj) -> Optional[bytes]:
+    """Concrete bytecode of a Disassembly, or None when it holds
+    symbolic bytes (runtime code returned by a creation tx can,
+    disassembler/disassembly.py assign_bytecode)."""
+    bc = getattr(code_obj, "bytecode", None)
+    if isinstance(bc, str):
+        try:
+            return bytes.fromhex(bc.replace("0x", ""))
+        except ValueError:
+            return None
+    if isinstance(bc, (bytes, bytearray)):
+        return bytes(bc)
+    if isinstance(bc, tuple):
+        from ..support.support_utils import fold_concrete_bytes
+
+        norm = fold_concrete_bytes(bc)
+        if all(isinstance(b, int) for b in norm):
+            return bytes(norm)
+    return None
+
+
+def _storage_read_term(seed_raw: "T.Term", key: BitVec) -> BitVec:
+    """The exact term Storage.__getitem__ builds for an in-memory read
+    (state/account.py:37-67 minus the dynamic-loader path): a select over
+    the storage array, simplified. Read-over-write folding makes the
+    select against the seed array identical to the interpreter's select
+    against the current array for any key that misses the write log."""
+    idx = key.raw
+    return simplify(BitVec(T.mk_select(seed_raw, idx), key.annotations))
+
+
+# ---------------------------------------------------------------------------
+# deferred-record resolution
+# ---------------------------------------------------------------------------
+
+# ops whose alu resolver takes pop-coerced bitvec args, keyed by arity
+_ALU2 = {
+    "ADD": alu.add, "SUB": alu.sub, "MUL": alu.mul, "DIV": alu.div,
+    "SDIV": alu.sdiv, "MOD": alu.mod, "SMOD": alu.smod,
+    "SIGNEXTEND": alu.signextend, "LT": alu.lt, "GT": alu.gt,
+    "SLT": alu.slt, "SGT": alu.sgt, "AND": alu.and_, "OR": alu.or_,
+    "XOR": alu.xor, "BYTE": alu.byte_op, "SHL": alu.shl,
+    "SHR": alu.shr, "SAR": alu.sar,
+}
+_ALU3 = {"ADDMOD": alu.addmod, "MULMOD": alu.mulmod}
+
+
+class LaneEngine:
+    """Owns one lane batch + object table for a single contract's
+    exploration."""
+
+    def __init__(self, n_lanes: int = 256, window: int = 48,
+                 step_budget: int = 8192, blocked_ops=None,
+                 **lane_kwargs):
+        self.n_lanes = n_lanes
+        self.window = window
+        self.step_budget = step_budget
+        self.lane_kwargs = lane_kwargs
+        # opcodes with registered detector hooks must park so the hooks
+        # fire host-side; remove them from the device-executable set
+        import jax.numpy as jnp
+
+        table = np.asarray(symstep.SYM_EXECUTABLE).copy()
+        for name in blocked_ops or ():
+            if name in _OPB:
+                table[_OPB[name]] = False
+        self.exec_table = jnp.asarray(table)
+        self.objects = ObjectTable()
+        self._func_names: Dict[int, str] = {}
+        # repeated CALLDATALOADs at the same offset across lanes resolve
+        # to the same word term; building it once matters (32 If+select
+        # terms per word)
+        self._cdl_cache: Dict[Tuple[int, int], BitVec] = {}
+        self.stats = {
+            "seeded": 0, "forks": 0, "records": 0, "parked": 0,
+            "dead": 0, "device_steps": 0, "windows": 0,
+        }
+
+    # -- seeding ------------------------------------------------------------
+    # (eligibility is decided by the caller: svm._lane_engine_sweep)
+
+    def _env_words(self, gs: GlobalState):
+        """(slot -> (concrete value | None, sid)) for the env plane,
+        mirroring the corresponding instruction handlers."""
+        env = gs.environment
+        ms = gs.mstate
+
+        def entry(val):
+            if isinstance(val, int):
+                return val, 0
+            if isinstance(val, BitVec) and val.value is not None:
+                return val.value, 0
+            return None, self.objects.add(val)
+
+        out = {}
+        out["ADDRESS"] = entry(env.address)
+        out["ORIGIN"] = entry(env.origin)
+        out["CALLER"] = entry(env.sender)
+        out["CALLVALUE"] = entry(env.callvalue)
+        out["GASPRICE"] = entry(env.gasprice)
+        out["COINBASE"] = entry(gs.new_bitvec("coinbase", 256))
+        out["TIMESTAMP"] = entry(
+            symbol_factory.BitVecSym("timestamp", 256))
+        out["NUMBER"] = entry(env.block_number)
+        out["DIFFICULTY"] = entry(gs.new_bitvec("block_difficulty", 256))
+        out["GASLIMIT"] = entry(ms.gas_limit)
+        out["CHAINID"] = entry(env.chainid)
+        out["SELFBALANCE"] = entry(env.active_account.balance())
+        out["BASEFEE"] = entry(env.basefee)
+        return out
+
+    def _seed_spec(self, gs: GlobalState, calldata_cap: int):
+        """(LaneCtx, host-side per-lane values) for one entry state."""
+        env = gs.environment
+        acct = env.active_account
+        ms = gs.mstate
+
+        # instruction index <-> byte address maps
+        ilist = env.code.instruction_list
+        code_len = len(code_to_bytes(env.code) or b"")
+        addr2idx = np.full(max(code_len + 2, 2), len(ilist),
+                           dtype=np.int32)
+        for i, ins in enumerate(ilist):
+            if ins["address"] < addr2idx.shape[0]:
+                addr2idx[ins["address"]] = i
+
+        storage_raw = acct.storage._standard_storage.raw
+        virgin_zero = (
+            storage_raw.op == T.CONST_ARRAY
+            and T.is_const(storage_raw.args[0])
+            and storage_raw.args[0].val == 0
+        )
+
+        calldata = env.calldata
+        concrete_cd = (
+            isinstance(calldata, ConcreteCalldata)
+            and all(isinstance(x, int)
+                    for x in calldata._concrete_calldata)
+            and len(calldata._concrete_calldata) <= calldata_cap
+        )
+
+        gas0_min, gas0_max = ms.min_gas_used, ms.max_gas_used
+        dev_limit = max(int(ms.gas_limit) - int(gas0_min), 0) \
+            if isinstance(ms.gas_limit, int) else 0xFFFFFFF
+
+        ctx = LaneCtx(gs, addr2idx, storage_raw, calldata,
+                      gas0_min, gas0_max)
+
+        envw = self._env_words(gs)
+        env_vals = np.zeros((symstep.N_ENV, bv256.NLIMBS), np.uint32)
+        env_sids = np.zeros(symstep.N_ENV, np.int32)
+        for name, slot in symstep.ENV_SLOTS.items():
+            val, sid = envw[name]
+            if sid:
+                env_sids[slot] = sid
+            else:
+                env_vals[slot] = bv256.int_to_limbs(val or 0)
+
+        cd_buf = np.zeros(calldata_cap, np.uint8)
+        cd_size = 0
+        cd_sym = 0
+        cd_size_sid = 0
+        if concrete_cd:
+            data = calldata._concrete_calldata
+            cd_buf[: len(data)] = np.asarray(data, np.uint8)
+            cd_size = len(data)
+        else:
+            cd_sym = 1
+            size = calldata.calldatasize
+            if isinstance(size, BitVec) and size.value is not None:
+                cd_size = min(int(size.value), 1 << 29)
+            else:
+                cd_size_sid = self.objects.add(size)
+
+        return ctx, dict(
+            sbase=0 if virgin_zero else 1,
+            calldata=cd_buf, cd_size=cd_size, cd_sym=cd_sym,
+            cd_size_sid=cd_size_sid, env=env_vals, env_sid=env_sids,
+            gas_limit=dev_limit,
+        )
+
+    def seed_all(self, st: SymLaneState, entries,
+                 ctxs: List[Optional[LaneCtx]]) -> SymLaneState:
+        """Batched device write of [(lane, GlobalState)] seeds: one
+        scatter per field instead of ~25 eager updates per lane."""
+        import jax.numpy as jnp
+
+        if not entries:
+            return st
+        cap = st.calldata.shape[1]
+        lanes, specs = [], []
+        for lane, gs in entries:
+            ctx, spec = self._seed_spec(gs, cap)
+            ctxs[lane] = ctx
+            lanes.append(lane)
+            specs.append(spec)
+        idx = jnp.asarray(np.asarray(lanes, np.int32))
+
+        def col(name, dtype):
+            return jnp.asarray(
+                np.asarray([s[name] for s in specs], dtype))
+
+        st = st._replace(
+            pc=st.pc.at[idx].set(0),
+            sp=st.sp.at[idx].set(0),
+            depth=st.depth.at[idx].set(0),
+            ssid=st.ssid.at[idx].set(0),
+            memory=st.memory.at[idx].set(0),
+            mkind=st.mkind.at[idx].set(0),
+            msize=st.msize.at[idx].set(0),
+            mlog_count=st.mlog_count.at[idx].set(0),
+            sval_sid=st.sval_sid.at[idx].set(0),
+            s_written=st.s_written.at[idx].set(0),
+            scount=st.scount.at[idx].set(0),
+            sbase=st.sbase.at[idx].set(col("sbase", np.int32)),
+            calldata=st.calldata.at[idx].set(
+                col("calldata", np.uint8)),
+            cd_size=st.cd_size.at[idx].set(col("cd_size", np.int32)),
+            cd_sym=st.cd_sym.at[idx].set(col("cd_sym", np.int32)),
+            cd_size_sid=st.cd_size_sid.at[idx].set(
+                col("cd_size_sid", np.int32)),
+            env=st.env.at[idx].set(col("env", np.uint32)),
+            env_sid=st.env_sid.at[idx].set(col("env_sid", np.int32)),
+            min_gas=st.min_gas.at[idx].set(0),
+            max_gas=st.max_gas.at[idx].set(0),
+            gas_limit=st.gas_limit.at[idx].set(
+                col("gas_limit", np.uint32)),
+            fentry=st.fentry.at[idx].set(-1),
+            status=st.status.at[idx].set(Status.RUNNING),
+            steps=st.steps.at[idx].set(0),
+            dlog_count=st.dlog_count.at[idx].set(0),
+            pclog_count=st.pclog_count.at[idx].set(0),
+            skeys=st.skeys.at[idx].set(0),
+            svals=st.svals.at[idx].set(0),
+        )
+        self.stats["seeded"] += len(entries)
+        return st
+
+    # -- drain ---------------------------------------------------------------
+
+    def _resolve_arg(self, sid: int, val_limbs, prov: Dict[Tuple[int, int],
+                                                           int], d_recs):
+        if sid == 0:
+            return _bv_val(_limbs_int(val_limbs))
+        if sid > 0:
+            return self.objects[sid]
+        idx = -sid - 1
+        key = (idx // d_recs, idx % d_recs)
+        return self.objects[prov[key]]
+
+    def _resolve_record(self, ctx: LaneCtx, opname: str, args):
+        """args: raw resolved operand objects in pop order."""
+        if opname in _ALU2:
+            return _ALU2[opname](alu.to_bitvec(args[0]),
+                                 alu.to_bitvec(args[1]))
+        if opname in _ALU3:
+            return _ALU3[opname](alu.to_bitvec(args[0]),
+                                 alu.to_bitvec(args[1]),
+                                 alu.to_bitvec(args[2]))
+        if opname == "EQ":
+            return alu.eq(args[0], args[1])
+        if opname == "ISZERO":
+            return alu.iszero(args[0])
+        if opname == "NOT":
+            return alu.not_(alu.to_bitvec(args[0]))
+        if opname == "EXP":
+            result, constraint = alu.exp(alu.to_bitvec(args[0]),
+                                         alu.to_bitvec(args[1]))
+            assert constraint is None, \
+                "device deferred an impure EXP (stepper bug)"
+            return result
+        if opname == "CALLDATALOAD":
+            off = alu.to_bitvec(args[0])
+            key = (id(ctx.calldata), off.raw.tid)
+            cached = self._cdl_cache.get(key)
+            if cached is None:
+                cached = ctx.calldata.get_word_at(off)
+                self._cdl_cache[key] = cached
+            return cached
+        if opname == "SLOAD":
+            return _storage_read_term(ctx.storage_seed_raw,
+                                      alu.to_bitvec(args[0]))
+        raise AssertionError(f"unresolvable deferred op {opname}")
+
+    def drain(self, st: SymLaneState,
+              ctxs: List[Optional[LaneCtx]]) -> Tuple[SymLaneState,
+                                                      List[int]]:
+        """Resolve all device logs; returns (updated state, dead lanes).
+        Dead lanes are paths whose latest condition folded to false (the
+        jumpi_ handler's trivial-falsity pruning)."""
+        import jax
+        import jax.numpy as jnp
+
+        d_recs = st.dlog_op.shape[1]
+        n = st.pc.shape[0]
+
+        # two-phase transfer: counts first (tiny), then only the rows of
+        # lanes that actually logged anything — the logs dominate bytes
+        # and ride a (possibly tunneled) device link
+        counts_h = jax.device_get({
+            "dlog_count": st.dlog_count,
+            "pclog_count": st.pclog_count,
+            "flog_count": st.flog_count,
+            "status": st.status,
+            "steps": st.steps,
+            "free_count": st.free_count,
+        })
+        self.last_counts = counts_h  # explore reads these (one pull)
+        act = np.nonzero(
+            (counts_h["dlog_count"] > 0) | (counts_h["pclog_count"] > 0)
+        )[0].astype(np.int32)
+        nf = int(counts_h["flog_count"])
+        act_j = jnp.asarray(act)
+        h = jax.device_get({
+            "dlog_op": st.dlog_op[act_j],
+            "dlog_sid": st.dlog_sid[act_j],
+            "dlog_val": st.dlog_val[act_j],
+            "dlog_step": st.dlog_step[act_j],
+            "pclog_sid": st.pclog_sid[act_j],
+            "pclog_neg": st.pclog_neg[act_j],
+            "flog_parent": st.flog_parent[:nf],
+            "flog_child": st.flog_child[:nf],
+            "ssid": st.ssid, "sval_sid": st.sval_sid,
+            "mlog_sid": st.mlog_sid,
+        })
+        row_of = {int(lane): i for i, lane in enumerate(act)}
+        h["dlog_count"] = counts_h["dlog_count"]
+        h["pclog_count"] = counts_h["pclog_count"]
+        h["flog_count"] = nf
+
+        # 1. fork genealogy (flog is already in step order)
+        for i in range(nf):
+            parent = int(h["flog_parent"][i])
+            child = int(h["flog_child"][i])
+            ctxs[child] = ctxs[parent].clone()
+        self.stats["forks"] += nf
+
+        # 2. deferred records in (step, lane, slot) order
+        recs = []
+        counts = h["dlog_count"]
+        for lane in np.nonzero(counts > 0)[0]:
+            row = row_of[int(lane)]
+            for k in range(int(counts[lane])):
+                recs.append((int(h["dlog_step"][row, k]), int(lane), k))
+        recs.sort()
+        prov: Dict[Tuple[int, int], int] = {}
+        for _, lane, k in recs:
+            row = row_of[lane]
+            opname = _OPN[int(h["dlog_op"][row, k])]
+            sids = h["dlog_sid"][row, k]
+            vals = h["dlog_val"][row, k]
+            args = [
+                self._resolve_arg(int(sids[j]), vals[j], prov, d_recs)
+                for j in range(3)
+            ]
+            obj = self._resolve_record(ctxs[lane], opname, args)
+            # sids model stack slots: apply MachineStack.append's
+            # coercion (state/machine_state.py — Bool/int pushes are
+            # wrapped into 256-bit BitVecs)
+            if isinstance(obj, Bool):
+                obj = If(obj, _bv_val(1), _bv_val(0))
+            elif isinstance(obj, int):
+                obj = _bv_val(obj)
+            prov[(lane, k)] = self.objects.add(obj)
+        self.stats["records"] += len(recs)
+
+        # 3. path conditions -> ctx.conds (jumpi_ handler semantics)
+        dead: List[int] = []
+        pcounts = h["pclog_count"]
+        for lane in np.nonzero(pcounts > 0)[0]:
+            lane = int(lane)
+            row = row_of[lane]
+            lane_dead = False
+            for j in range(int(pcounts[lane])):
+                sid = int(h["pclog_sid"][row, j])
+                neg = int(h["pclog_neg"][row, j])
+                if sid > 0:
+                    cond = self.objects[sid]
+                else:
+                    idx = -sid - 1
+                    cond = self.objects[prov[(idx // d_recs,
+                                              idx % d_recs)]]
+                if isinstance(cond, Bool):
+                    chosen = simplify(Not(cond)) if neg \
+                        else simplify(cond)
+                else:
+                    chosen = (cond == 0) if neg else (cond != 0)
+                if chosen.is_false:
+                    lane_dead = True
+                    break
+                ctxs[lane].conds.append(chosen)
+            if lane_dead:
+                dead.append(lane)
+        self.stats["dead"] += len(dead)
+
+        # 4. provisional sid rewrite
+        prov_arr = np.full((n, d_recs), -1, np.int32)
+        for (lane, k), oid in prov.items():
+            prov_arr[lane, k] = oid
+
+        def remap(plane):
+            negm = plane < 0
+            if not negm.any():
+                return plane, False
+            idx = np.where(negm, -plane - 1, 0)
+            mapped = prov_arr[idx // d_recs, idx % d_recs]
+            assert not (negm & (mapped < 0)).any(), \
+                "unresolved provisional sid"
+            return np.where(negm, mapped, plane), True
+
+        ssid2, ch1 = remap(h["ssid"])
+        sval2, ch2 = remap(h["sval_sid"])
+        mlog2, ch3 = remap(h["mlog_sid"])
+
+        zero_i = jnp.zeros_like(st.dlog_count)
+        st = st._replace(
+            ssid=jnp.asarray(ssid2) if ch1 else st.ssid,
+            sval_sid=jnp.asarray(sval2) if ch2 else st.sval_sid,
+            mlog_sid=jnp.asarray(mlog2) if ch3 else st.mlog_sid,
+            dlog_count=zero_i,
+            pclog_count=jnp.zeros_like(st.pclog_count),
+            flog_count=jnp.zeros_like(st.flog_count),
+        )
+        return st, dead
+
+    # -- materialization -----------------------------------------------------
+
+    def materialize(self, st_host: dict, lane: int,
+                    ctx: LaneCtx) -> GlobalState:
+        """Rebuild a host GlobalState for a parked lane. `st_host` is a
+        device_get of the SymLaneState."""
+        gs = deepcopy(ctx.template)
+        ms = gs.mstate
+
+        for cond in ctx.conds:
+            gs.world_state.constraints.append(cond)
+
+        byte_pc = int(st_host["pc"][lane])
+        ms.pc = int(ctx.addr2idx[min(byte_pc,
+                                     ctx.addr2idx.shape[0] - 1)])
+        ms.depth += int(st_host["depth"][lane])
+        # active function from the last function-entry jump the device
+        # took (svm._new_node_state parity for host-executed jumps)
+        fentry = int(st_host["fentry"][lane])
+        if fentry >= 0 and fentry in self._func_names:
+            gs.environment.active_function_name = \
+                self._func_names[fentry]
+        ms.min_gas_used = ctx.gas0_min + int(st_host["min_gas"][lane])
+        ms.max_gas_used = ctx.gas0_max + int(st_host["max_gas"][lane])
+
+        # stack
+        sp = int(st_host["sp"][lane])
+        for s in range(sp):
+            sid = int(st_host["ssid"][lane, s])
+            if sid:
+                ms.stack.append(self.objects[sid])
+            else:
+                ms.stack.append(
+                    _bv_val(_limbs_int(st_host["stack"][lane, s])))
+
+        # memory: reproduce the byte-level representation the Memory
+        # class would hold after the same writes — MSTORE8 bytes as
+        # ints, concrete-word bytes as 8-bit const terms, symbolic-word
+        # bytes as Extract slices (state/memory.py:61-88)
+        msize = int(st_host["msize"][lane])
+        if msize:
+            ms.memory.extend(msize)
+            mem = st_host["memory"][lane]
+            kind = st_host["mkind"][lane]
+            sym_cover: Dict[int, Tuple[object, int]] = {}
+            for r in range(int(st_host["mlog_count"][lane])):
+                off = int(st_host["mlog_off"][lane, r])
+                ln = int(st_host["mlog_len"][lane, r])
+                obj = self.objects[int(st_host["mlog_sid"][lane, r])]
+                for j in range(ln):
+                    sym_cover[off + j] = (obj, j)
+            for i in np.nonzero(kind)[0]:
+                i = int(i)
+                k = int(kind[i])
+                if k == symstep.KIND_BYTE_INT:
+                    ms.memory[i] = int(mem[i])
+                elif k == symstep.KIND_CONC_WORD:
+                    ms.memory[i] = symbol_factory.BitVecVal(
+                        int(mem[i]), 8)
+                else:  # KIND_SYM_WORD
+                    obj, j = sym_cover[i]
+                    if isinstance(obj, Bool):
+                        obj = If(obj, _bv_val(1), _bv_val(0))
+                    ms.memory[i] = simplify(
+                        Extract(255 - 8 * j, 248 - 8 * j, obj))
+
+        # storage: read-cache entries repopulate keys_get, written
+        # entries replay as stores
+        acct = gs.environment.active_account
+        any_written = False
+        for r in range(int(st_host["scount"][lane])):
+            key = _bv_val(_limbs_int(st_host["skeys"][lane, r]))
+            written = int(st_host["s_written"][lane, r])
+            sid = int(st_host["sval_sid"][lane, r])
+            if written:
+                any_written = True
+                if sid:
+                    acct.storage[key] = self.objects[sid]
+                else:
+                    acct.storage[key] = _bv_val(
+                        _limbs_int(st_host["svals"][lane, r]))
+            else:
+                _ = acct.storage[key]
+        if any_written:
+            # device-executed SSTOREs must leave the same mark the
+            # mutation-pruner's SSTORE hook would have left, or clean-
+            # path pruning drops the mutated end state
+            from .plugin.plugins.plugin_annotations import (
+                MutationAnnotation,
+            )
+            if not list(gs.get_annotations(MutationAnnotation)):
+                gs.annotate(MutationAnnotation())
+
+        self.stats["parked"] += 1
+        return gs
+
+    # -- top-level loop ------------------------------------------------------
+
+    def explore(self, code_bytes: bytes,
+                entry_states: List[GlobalState]) -> List[GlobalState]:
+        """Run entry states on device until every path parks or dies;
+        returns the materialized parked states (each positioned at the
+        first instruction the device could not execute)."""
+        import jax
+
+        self._func_names = dict(
+            getattr(entry_states[0].environment.code,
+                    "address_to_function_name", {}) or {}
+        ) if entry_states else {}
+        cc = compile_code(code_bytes,
+                          func_entries=self._func_names.keys())
+        st = symstep.init_sym_lanes(self.n_lanes, **self.lane_kwargs)
+        ctxs: List[Optional[LaneCtx]] = [None] * self.n_lanes
+        queue = deque(entry_states)
+        free = list(range(self.n_lanes - 1, -1, -1))
+        results: List[GlobalState] = []
+        import jax.numpy as jnp
+
+        while True:
+            entries = []
+            while queue and free:
+                entries.append((free.pop(), queue.popleft()))
+            st = self.seed_all(st, entries, ctxs)
+            fs = np.zeros(self.n_lanes, np.int32)
+            fs[: len(free)] = free
+            st = st._replace(
+                free_slots=jnp.asarray(fs),
+                free_count=jnp.asarray(len(free), jnp.int32),
+            )
+            n_free_written = len(free)
+            st = symstep.sym_run_jit(cc, st, self.window,
+                                     self.exec_table)
+            self.stats["windows"] += 1
+            st, dead = self.drain(st, ctxs)
+            # drain pulled status/steps/free_count in its counts batch
+            status = self.last_counts["status"].copy()
+            steps = self.last_counts["steps"]
+            # forked children consumed slots from the top (tail) of the
+            # free stack; reconcile before re-seeding
+            consumed = n_free_written - int(self.last_counts["free_count"])
+            if consumed:
+                free = free[: n_free_written - consumed]
+            # force-park runaway lanes (host loop-bound machinery takes
+            # over from the materialized state)
+            runaway = (status == Status.RUNNING) \
+                & (steps >= self.step_budget)
+            parked = (status == Status.NEEDS_HOST) | runaway
+            for lane in dead:
+                parked[lane] = False
+
+            retire = sorted(set(np.nonzero(parked)[0].tolist())
+                            | set(dead))
+            if retire:
+                # transfer only the retired lanes' rows (device-side
+                # gather): the memory/stack planes dominate bytes
+                ridx = jnp.asarray(np.asarray(retire, np.int32))
+                st_host = jax.device_get({
+                    "pc": st.pc[ridx], "sp": st.sp[ridx],
+                    "depth": st.depth[ridx], "fentry": st.fentry[ridx],
+                    "stack": st.stack[ridx], "ssid": st.ssid[ridx],
+                    "memory": st.memory[ridx], "mkind": st.mkind[ridx],
+                    "msize": st.msize[ridx],
+                    "mlog_off": st.mlog_off[ridx],
+                    "mlog_len": st.mlog_len[ridx],
+                    "mlog_sid": st.mlog_sid[ridx],
+                    "mlog_count": st.mlog_count[ridx],
+                    "skeys": st.skeys[ridx], "svals": st.svals[ridx],
+                    "sval_sid": st.sval_sid[ridx],
+                    "s_written": st.s_written[ridx],
+                    "scount": st.scount[ridx],
+                    "min_gas": st.min_gas[ridx],
+                    "max_gas": st.max_gas[ridx],
+                })
+                dead_set = set(dead)
+                for row, lane in enumerate(retire):
+                    self.stats["device_steps"] += int(steps[lane])
+                    if lane not in dead_set:
+                        results.append(
+                            self.materialize(st_host, row, ctxs[lane]))
+                    ctxs[lane] = None
+                    free.append(lane)
+                st = st._replace(status=st.status.at[ridx].set(DEAD))
+                status[np.asarray(retire, np.int32)] = DEAD
+
+            running = int(np.sum(status == Status.RUNNING))
+            if not running and not queue:
+                break
+        return results

@@ -35,6 +35,9 @@ class InstructionProfiler(LaserPlugin):
             def start_profile(_state):
                 self.start_time = datetime.now()
 
+            # telemetry-only: the lane-engine sweep may skip these for
+            # device-executed instructions (svm._lane_engine_sweep)
+            start_profile.lane_engine_safe = True
             return start_profile
 
         @symbolic_vm.instr_hook("post", None)
@@ -55,6 +58,7 @@ class InstructionProfiler(LaserPlugin):
                     r.count + 1,
                 )
 
+            stop_profile.lane_engine_safe = True
             return stop_profile
 
         @symbolic_vm.laser_hook("stop_sym_exec")

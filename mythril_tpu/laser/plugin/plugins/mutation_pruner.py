@@ -26,6 +26,10 @@ class MutationPruner(LaserPlugin):
     end states at add_world_state."""
 
     def initialize(self, symbolic_vm):
+        # these hooks are lane_engine_safe: the lane bridge replicates
+        # the annotation for device-executed SSTOREs
+        # (laser/lane_engine.py materialize), and CALL/STATICCALL always
+        # park to the host where the hook fires normally
         @symbolic_vm.pre_hook("SSTORE")
         def sstore_mutator_hook(global_state: GlobalState):
             global_state.annotate(MutationAnnotation())
@@ -37,6 +41,10 @@ class MutationPruner(LaserPlugin):
         @symbolic_vm.pre_hook("STATICCALL")
         def staticcall_mutator_hook(global_state: GlobalState):
             global_state.annotate(MutationAnnotation())
+
+        sstore_mutator_hook.lane_engine_safe = True
+        call_mutator_hook.lane_engine_safe = True
+        staticcall_mutator_hook.lane_engine_safe = True
 
         @symbolic_vm.laser_hook("add_world_state")
         def world_state_filter_hook(global_state: GlobalState):

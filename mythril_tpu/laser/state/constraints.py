@@ -46,6 +46,13 @@ class Constraints(list):
             if isinstance(constraint, Bool)
             else symbol_factory.Bool(constraint)
         )
+        # trivially-true constraints (e.g. a concrete JUMPI's folded
+        # condition) carry no information: dropping them keeps solver
+        # input minimal and makes the interpreter's constraint list
+        # identical to the lane engine's, which never records concrete
+        # branches
+        if constraint.is_true:
+            return
         super(Constraints, self).append(constraint)
 
     @property

@@ -275,11 +275,96 @@ class LaserEVM:
 
     # -- the hot loop -------------------------------------------------------
 
+    def _lane_engine_sweep(self) -> None:
+        """Run tx-entry worklist states through the TPU lane engine
+        (laser/lane_engine.py): the device executes the symbolic
+        ALU/stack/memory/storage/jump core of every path in batch, forks
+        on symbolic JUMPIs, and hands back states parked at the first
+        instruction it cannot model. The host loop below continues from
+        those, so hooks/detectors/transaction semantics are unchanged
+        for everything host-executed."""
+        try:
+            from .lane_engine import LaneEngine, code_to_bytes
+        except Exception as e:  # jax/device init failure -> host path
+            log.warning("lane engine unavailable (%s)", e)
+            return
+        from .transaction import MessageCallTransaction
+
+        eligible, rest = [], []
+        for gs in self.work_list:
+            ms = gs.mstate
+            storage = gs.environment.active_account.storage
+            code = code_to_bytes(gs.environment.code)
+            if (
+                code
+                and ms.pc == 0
+                and len(ms.stack) == 0
+                and ms.memory_size == 0
+                and len(ms.subroutine_stack) == 0
+                and not gs.environment.static
+                and isinstance(gs.current_transaction,
+                               MessageCallTransaction)
+                and not (storage.dynld and storage.dynld.active)
+            ):
+                eligible.append((code, gs))
+            else:
+                rest.append(gs)
+        if not eligible:
+            return
+        # every opcode with a registered hook must park device-side so
+        # the hook fires on the host; universal per-instruction hooks
+        # disable the sweep outright — except telemetry-only hooks
+        # (marked lane_engine_safe, e.g. the instruction profiler's)
+        def _essential(hooks):
+            return [h for h in hooks
+                    if not getattr(h, "lane_engine_safe", False)]
+
+        if any(_essential(h) for h in self.instr_pre_hook.values()) \
+                or any(_essential(h)
+                       for h in self.instr_post_hook.values()):
+            return
+        blocked = {op for op, hooks in self.pre_hooks.items()
+                   if _essential(hooks)}
+        blocked |= {op for op, hooks in self.post_hooks.items()
+                    if _essential(hooks)}
+        if "JUMPI" in blocked:
+            # a detector hooks every branch: the device cannot fork, so
+            # batching buys nothing — stay on the host path (the drain-
+            # side hook adapter lifting this is future work)
+            log.info("lane engine idle: a loaded module hooks JUMPI")
+            return
+        groups: Dict[bytes, List[GlobalState]] = {}
+        for code, gs in eligible:
+            groups.setdefault(code, []).append(gs)
+        del self.work_list[:]
+        self.work_list.extend(rest)
+        for code, states in groups.items():
+            try:
+                engine = LaneEngine(n_lanes=args.tpu_lanes,
+                                    blocked_ops=blocked)
+                parked = engine.explore(code, states)
+            except Exception as e:  # any failure falls back to host
+                log.warning(
+                    "lane engine failed (%s); continuing host-side", e)
+                self.work_list.extend(states)
+                continue
+            self.work_list.extend(parked)
+            self.total_states += engine.stats["device_steps"]
+            log.info(
+                "lane engine: %d entries -> %d parked states "
+                "(%d forks, %d device steps, %d records, %d windows)",
+                len(states), len(parked), engine.stats["forks"],
+                engine.stats["device_steps"], engine.stats["records"],
+                engine.stats["windows"],
+            )
+
     def exec(self, create=False, track_gas=False
              ) -> Optional[List[GlobalState]]:
         final_states: List[GlobalState] = []
         for hook in self._start_exec_hooks:
             hook()
+        if args.tpu_lanes and not create and not track_gas:
+            self._lane_engine_sweep()
 
         for global_state in self.strategy:
             if create and self._check_create_termination():
@@ -526,6 +611,18 @@ class LaserEVM:
 
     # -- CFG ----------------------------------------------------------------
 
+    @staticmethod
+    def _branch_condition(state: GlobalState):
+        """CFG edge label for a conditional transition: the real branch
+        condition when the fork recorded one (trivially-true conditions
+        are not kept in the constraint list), else the latest path
+        constraint."""
+        cond = getattr(state, "branch_condition", None)
+        if cond is not None:
+            return cond
+        constraints = state.world_state.constraints
+        return constraints[-1] if len(constraints) else None
+
     def manage_cfg(self, opcode: Optional[str],
                    new_states: List[GlobalState]) -> None:
         if opcode == "JUMP":
@@ -536,16 +633,14 @@ class LaserEVM:
             assert len(new_states) <= 2
             for state in new_states:
                 self._new_node_state(
-                    state,
-                    JumpType.CONDITIONAL,
-                    state.world_state.constraints[-1],
+                    state, JumpType.CONDITIONAL,
+                    self._branch_condition(state),
                 )
         elif opcode in ("SLOAD", "SSTORE") and len(new_states) > 1:
             for state in new_states:
                 self._new_node_state(
-                    state,
-                    JumpType.CONDITIONAL,
-                    state.world_state.constraints[-1],
+                    state, JumpType.CONDITIONAL,
+                    self._branch_condition(state),
                 )
         elif opcode == "RETURN":
             for state in new_states:

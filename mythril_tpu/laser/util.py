@@ -41,18 +41,11 @@ def get_trace_line(instr: Dict, state) -> str:
 
 
 def pop_bitvec(state) -> BitVec:
-    """Pop a stack item coerced to a 256-bit BitVec."""
-    item = state.stack.pop()
-    if isinstance(item, Bool):
-        return If(
-            item,
-            symbol_factory.BitVecVal(1, 256),
-            symbol_factory.BitVecVal(0, 256),
-        )
-    if isinstance(item, int):
-        return symbol_factory.BitVecVal(item, 256)
-    item.raw = simplify(item).raw
-    return item
+    """Pop a stack item coerced to a 256-bit BitVec (shared coercion:
+    laser/alu.py to_bitvec, also used by the lane-engine drain)."""
+    from . import alu
+
+    return alu.to_bitvec(state.stack.pop())
 
 
 def get_concrete_int(item: Union[int, Expression]) -> int:

@@ -191,15 +191,23 @@ class CompiledCode(NamedTuple):
     push_value: jnp.ndarray  # (L+1, 8) uint32: 256-bit immediate at pc
     next_pc: jnp.ndarray  # (L+1,) int32: pc + 1 + push_len
     is_jumpdest: jnp.ndarray  # (L+1,) bool
+    is_func_entry: jnp.ndarray  # (L+1,) bool — selector-dispatch targets
     size: int  # real code length (static)
 
 
-def compile_code(code: bytes) -> CompiledCode:
+def compile_code(code: bytes, func_entries=()) -> CompiledCode:
+    """func_entries: byte addresses of function entry points (the
+    Disassembly's address_to_function_name keys); lanes jumping there
+    record it so materialized states carry the active function name."""
     length = len(code)
     opcode = np.full(length + 1, _OP["STOP"], dtype=np.int32)
     push_value = np.zeros((length + 1, bv256.NLIMBS), dtype=np.uint32)
     next_pc = np.arange(1, length + 2, dtype=np.int32)
     is_jumpdest = np.zeros(length + 1, dtype=bool)
+    is_func_entry = np.zeros(length + 1, dtype=bool)
+    for addr in func_entries:
+        if 0 <= addr <= length:
+            is_func_entry[addr] = True
 
     i = 0
     while i < length:
@@ -219,6 +227,7 @@ def compile_code(code: bytes) -> CompiledCode:
         push_value=jnp.asarray(push_value),
         next_pc=jnp.asarray(next_pc),
         is_jumpdest=jnp.asarray(is_jumpdest),
+        is_func_entry=jnp.asarray(is_func_entry),
         size=length,
     )
 

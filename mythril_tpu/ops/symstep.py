@@ -25,10 +25,13 @@ paths execute per device step and symbolic values are *handles*:
   reference's two deepcopies + constraint append,
   instructions.py:1597-1633). Fork slots come from a device-side free
   list refilled by the host;
-- memory gains a bounded symbolic **overlay log** (offset, len, sid) over
-  the concrete byte plane: aligned 32-byte symbolic store/load pairs (the
-  dominant Solidity scratch-space pattern) resolve on device, partial
-  overlaps park;
+- memory keeps three planes: concrete bytes, a per-byte **writer-kind**
+  plane (never-written / MSTORE8-int / concrete-word / symbolic-word —
+  the distinction state/memory.py makes between int and 8-bit-term
+  entries), and a bounded symbolic **overlay log** (offset, len, sid)
+  recording only symbolic word stores. Aligned 32-byte symbolic
+  store/load pairs (the dominant Solidity scratch-space pattern) resolve
+  on device; loads mixing symbolic and concrete bytes park;
 - storage entries carry value sids and a `written` flag; misses against a
   symbolic base array defer to a select() built at drain time and are
   cached in the log so repeated loads are device-local;
@@ -81,6 +84,14 @@ DEAD = 7  # free slot (never executed / retired)
 GAS_MEMORY = 3
 GAS_MEMORY_QUAD_DENOM = 512
 
+# memory writer kinds (per byte): the host Memory stores MSTORE8 bytes as
+# ints but word-store bytes as 8-bit terms (state/memory.py:61-88,111-132);
+# materialization must reproduce that representation exactly
+KIND_NONE = 0
+KIND_BYTE_INT = 1    # MSTORE8 with concrete value
+KIND_CONC_WORD = 2   # MSTORE with concrete value
+KIND_SYM_WORD = 3    # MSTORE with symbolic value (overlay log has sid)
+
 
 def _build_sym_tables():
     gas_min = np.zeros(256, dtype=np.uint32)
@@ -128,14 +139,16 @@ class SymLaneState(NamedTuple):
     pc: jnp.ndarray            # (N,) i32 — byte address
     sp: jnp.ndarray            # (N,) i32
     depth: jnp.ndarray         # (N,) i32 — JUMPI fork depth (host parity)
+    fentry: jnp.ndarray        # (N,) i32 — last function-entry jump dest
+    #                            (-1 = none; svm._new_node_state parity)
     stack: jnp.ndarray         # (N, D, 8) u32
     ssid: jnp.ndarray          # (N, D) i32
     memory: jnp.ndarray        # (N, M) u8
+    mkind: jnp.ndarray         # (N, M) u8 — KIND_* per byte
     msize: jnp.ndarray         # (N,) i32
-    msym: jnp.ndarray          # (N,) i32 — symbolic overlay records live
     mlog_off: jnp.ndarray      # (N, MR) i32
     mlog_len: jnp.ndarray      # (N, MR) i32
-    mlog_sid: jnp.ndarray      # (N, MR) i32 (0 = concrete-write marker)
+    mlog_sid: jnp.ndarray      # (N, MR) i32 — symbolic word stores only
     mlog_count: jnp.ndarray    # (N,) i32
     skeys: jnp.ndarray         # (N, S, 8) u32
     svals: jnp.ndarray         # (N, S, 8) u32
@@ -192,11 +205,12 @@ def init_sym_lanes(
         pc=z((n,), jnp.int32),
         sp=z((n,), jnp.int32),
         depth=z((n,), jnp.int32),
+        fentry=jnp.full((n,), -1, jnp.int32),
         stack=z((n, stack_depth, bv256.NLIMBS), jnp.uint32),
         ssid=z((n, stack_depth), jnp.int32),
         memory=z((n, memory_bytes), jnp.uint8),
+        mkind=z((n, memory_bytes), jnp.uint8),
         msize=z((n,), jnp.int32),
-        msym=z((n,), jnp.int32),
         mlog_off=z((n, mem_records), jnp.int32),
         mlog_len=z((n, mem_records), jnp.int32),
         mlog_sid=z((n, mem_records), jnp.int32),
@@ -266,8 +280,16 @@ def _mem_fee(old_bytes, new_bytes):
     return new_fee - old_fee
 
 
-def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
-    """Advance every running lane by one instruction (symbolic mode)."""
+def sym_step(code: CompiledCode, st: SymLaneState,
+             exec_table: jnp.ndarray = None) -> SymLaneState:
+    """Advance every running lane by one instruction (symbolic mode).
+
+    exec_table: optional (256,) bool — the set of opcodes the device may
+    execute this run. The bridge passes SYM_EXECUTABLE minus every
+    opcode with a registered detector pre/post hook, so hooked
+    instructions always park and fire their hooks host-side."""
+    if exec_table is None:
+        exec_table = SYM_EXECUTABLE
     n, depth_cap, _ = st.stack.shape
     mem_bytes = st.memory.shape[1]
     mem_recs = st.mlog_off.shape[1]
@@ -353,38 +375,39 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
     exp_pure = ~sym_a & (a_popcount <= 1)
 
     # ---- memory overlay decisions (MLOAD) ---------------------------------
+    # the kind plane decides concrete vs symbolic reads; the overlay log
+    # (symbolic word stores only, in program order) supplies the sid for
+    # an exact all-symbolic hit
+    byte_idx32 = mem_off[:, None] + jnp.arange(32)[None, :]
+    byte_idx32_c = jnp.clip(byte_idx32, 0, mem_bytes - 1)
+    kinds32 = jnp.take_along_axis(st.mkind, byte_idx32_c, axis=1)
+    any_sym_byte = jnp.any(kinds32 == KIND_SYM_WORD, axis=1)
+    all_sym_byte = jnp.all(kinds32 == KIND_SYM_WORD, axis=1)
+
     rec_ids = jnp.arange(mem_recs)[None, :]
     live_rec = rec_ids < st.mlog_count[:, None]
-    ov = (
+    ov_sym = (
         live_rec
         & (st.mlog_off < mem_end[:, None])
         & ((st.mlog_off + st.mlog_len) > mem_off[:, None])
     )
-    ov_sym = ov & (st.mlog_sid != 0)
-    last_any = jnp.max(jnp.where(ov, rec_ids + 1, 0), axis=1) - 1
     last_sym = jnp.max(jnp.where(ov_sym, rec_ids + 1, 0), axis=1) - 1
-    la_c = jnp.clip(last_any, 0, mem_recs - 1)
-    la_off = _gather_flat(st.mlog_off, la_c)
-    la_len = _gather_flat(st.mlog_len, la_c)
-    la_sid = _gather_flat(st.mlog_sid, la_c)
-    no_sym_ov = last_sym < 0
+    ls_c = jnp.clip(last_sym, 0, mem_recs - 1)
+    ls_off = _gather_flat(st.mlog_off, ls_c)
+    ls_len = _gather_flat(st.mlog_len, ls_c)
+    ls_sid = _gather_flat(st.mlog_sid, ls_c)
     top_sym_exact = (
-        (last_any == last_sym) & (last_sym >= 0)
-        & (la_off == mem_off) & (la_len == 32)
+        all_sym_byte & (last_sym >= 0)
+        & (ls_off == mem_off) & (ls_len == 32)
     )
-    top_conc_cover = (
-        (last_any >= 0) & (la_sid == 0)
-        & (la_off <= mem_off) & ((la_off + la_len) >= mem_end)
-    )
-    mload_sym_sid = jnp.where(top_sym_exact, la_sid, 0)
-    mload_conc_ok = no_sym_ov | top_conc_cover
+    mload_sym_sid = jnp.where(top_sym_exact, ls_sid, 0)
+    mload_conc_ok = ~any_sym_byte
     mload_park = is_mload & ~sym_a & ~mem_oob \
         & ~(top_sym_exact | mload_conc_ok)
 
-    # MSTORE/MSTORE8 record requirements
+    # MSTORE of a symbolic word appends an overlay record
     sym_store_val = is_mstore & sym_b
-    need_mrec = (is_mstore | is_mstore8) & (sym_store_val | (st.msym > 0))
-    mlog_full = need_mrec & (st.mlog_count >= mem_recs)
+    mlog_full = sym_store_val & (st.mlog_count >= mem_recs)
 
     # ---- storage decisions -------------------------------------------------
     slot_ids = jnp.arange(s_slots)[None, :]
@@ -396,10 +419,12 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
     s_idx = jnp.clip(best - 1, 0, s_slots - 1)
     sload_hit_val = _onehot_gather(st.svals, s_idx)
     sload_hit_sid = _gather_flat(st.sval_sid, s_idx)
-    sload_miss_sym = is_sload & ~sym_a & ~s_found & (st.sbase != 0)
-    storage_insert = (
-        (is_sstore & ~sym_a & ~s_found) | sload_miss_sym
-    )
+    sload_miss = is_sload & ~sym_a & ~s_found
+    # misses against a symbolic base defer to a select() term; misses
+    # against the zero K-array are concrete 0 — both are cached in the
+    # log (written=0) so materialization can replay keys_get
+    sload_miss_sym = sload_miss & (st.sbase != 0)
+    storage_insert = (is_sstore & ~sym_a & ~s_found) | sload_miss
     storage_full = storage_insert & (st.scount >= s_slots)
 
     # ---- calldata ---------------------------------------------------------
@@ -427,7 +452,7 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
 
     # ---- park resolution (everything except fork capacity) ----------------
     park0 = (
-        ~SYM_EXECUTABLE[op]
+        ~exec_table[op]
         | underflow
         | overflow
         | oog
@@ -543,41 +568,52 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
 
     # ---- memory execution -------------------------------------------------
     def _memory_block():
-        byte_idx = mem_off[:, None] + jnp.arange(32)[None, :]
-        byte_idx_c = jnp.clip(byte_idx, 0, mem_bytes - 1)
-        mem_read = jnp.take_along_axis(st.memory, byte_idx_c, axis=1)
+        mem_read = jnp.take_along_axis(st.memory, byte_idx32_c, axis=1)
         mload = bytes_be_to_word(mem_read)
 
         store_bytes = word_to_bytes_be(b)
         do_mstore = ok & is_mstore & ~sym_b
-        scatter_idx = jnp.where(do_mstore[:, None], byte_idx, mem_bytes)
+        scatter_idx = jnp.where(do_mstore[:, None], byte_idx32,
+                                mem_bytes)
         mem = st.memory.at[lanes[:, None], scatter_idx].set(
             store_bytes, mode="drop"
+        )
+        # writer-kind plane: concrete word = 2, symbolic word = 3,
+        # concrete byte = 1
+        do_store_any = ok & is_mstore
+        kind_idx = jnp.where(do_store_any[:, None], byte_idx32,
+                             mem_bytes)
+        kind_val = jnp.where(
+            sym_store_val, KIND_SYM_WORD, KIND_CONC_WORD
+        ).astype(jnp.uint8)
+        mkind = st.mkind.at[lanes[:, None], kind_idx].set(
+            jnp.broadcast_to(kind_val[:, None], byte_idx32.shape),
+            mode="drop",
         )
         do_mstore8 = ok & is_mstore8
         b8 = (b[..., 0] & 0xFF).astype(jnp.uint8)
         idx8 = jnp.where(do_mstore8, mem_off, mem_bytes)
         mem = mem.at[lanes, idx8].set(b8, mode="drop")
+        mkind = mkind.at[lanes, idx8].set(
+            jnp.uint8(KIND_BYTE_INT), mode="drop")
 
-        # overlay records
-        do_rec = ok & need_mrec
+        # overlay record for symbolic word stores
+        do_rec = ok & sym_store_val
         rec_pos = jnp.clip(st.mlog_count, 0, mem_recs - 1)
-        rec_sid = jnp.where(sym_store_val, sid_b, 0)
         mlog_off_n = _scatter_flat(st.mlog_off, do_rec, rec_pos, mem_off)
         mlog_len_n = _scatter_flat(st.mlog_len, do_rec, rec_pos, acc_len)
-        mlog_sid_n = _scatter_flat(st.mlog_sid, do_rec, rec_pos, rec_sid)
+        mlog_sid_n = _scatter_flat(st.mlog_sid, do_rec, rec_pos, sid_b)
         mlog_count_n = jnp.where(do_rec, st.mlog_count + 1,
                                  st.mlog_count)
-        msym_n = jnp.where(ok & sym_store_val, st.msym + 1, st.msym)
-        return (mem, mload, mlog_off_n, mlog_len_n, mlog_sid_n,
-                mlog_count_n, msym_n)
+        return (mem, mkind, mload, mlog_off_n, mlog_len_n, mlog_sid_n,
+                mlog_count_n)
 
-    (memory, mload_r, mlog_off2, mlog_len2, mlog_sid2, mlog_count2,
-     msym2) = lax.cond(
+    (memory, mkind2, mload_r, mlog_off2, mlog_len2, mlog_sid2,
+     mlog_count2) = lax.cond(
         jnp.any(ok & mem_ops),
         _memory_block,
-        lambda: (st.memory, zero_w, st.mlog_off, st.mlog_len,
-                 st.mlog_sid, st.mlog_count, st.msym),
+        lambda: (st.memory, st.mkind, zero_w, st.mlog_off, st.mlog_len,
+                 st.mlog_sid, st.mlog_count),
     )
     msize2 = jnp.where(ok & mem_ops, new_msize, st.msize)
     msize_r = bv256.from_u32(msize2.astype(jnp.uint32))
@@ -592,11 +628,13 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
         ins_pos = jnp.where(s_found, s_idx, st.scount)
         pos_c = jnp.clip(ins_pos, 0, s_slots - 1)
         do_sstore = ok & is_sstore
-        do_cache = ok & sload_miss_sym
+        do_cache = ok & sload_miss
         do_write = do_sstore | do_cache
         new_key = a
         new_val = jnp.where(do_sstore[:, None], b, zero_w)
-        new_sid = jnp.where(do_sstore, sid_b, prov_id)
+        new_sid = jnp.where(
+            do_sstore, sid_b,
+            jnp.where(sload_miss_sym, prov_id, 0))
         new_written = jnp.where(do_sstore, 1, 0)
         sk = _scatter_word(st.skeys, do_write, pos_c, new_key)
         sv = _scatter_word(st.svals, do_write, pos_c, new_val)
@@ -642,9 +680,12 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
     env_r = _onehot_gather(st.env, jnp.clip(env_idx, 0, N_ENV - 1))
     env_sid_r = _gather_flat(st.env_sid, jnp.clip(env_idx, 0, N_ENV - 1))
     pc_r = bv256.from_u32(st.pc.astype(jnp.uint32))
-    # GAS pushes the concrete block gas limit (host parity: gas_ pushes
-    # mstate.gas_limit, laser/instructions.py)
-    gas_r = bv256.from_u32(st.gas_limit)
+    # GAS pushes mstate.gas_limit (host parity: gas_ in
+    # laser/instructions.py) — the same value the GASLIMIT env slot is
+    # seeded with, NOT the device's oog budget (which is reduced by the
+    # seed state's gas already used)
+    gl_slot = ENV_SLOTS["GASLIMIT"]
+    gas_r = st.env[:, gl_slot, :]
     cds_r = bv256.from_u32(st.cd_size.astype(jnp.uint32))
     codesize_r = bv256.from_u32(jnp.full((n,), code.size, jnp.uint32))
     push_r = code.push_value[pc_c]
@@ -674,6 +715,8 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
         env_sid_r, result_sid)
     result_sid = jnp.where(
         ~defer & (op == _OP["CALLDATASIZE"]), st.cd_size_sid, result_sid)
+    result_sid = jnp.where(
+        ~defer & (op == _OP["GAS"]), st.env_sid[:, gl_slot], result_sid)
     result_sid = jnp.where(~defer & is_dup, dup_sid, result_sid)
     result_sid = jnp.where(
         ~defer & is_mload, mload_sym_sid, result_sid)
@@ -737,6 +780,17 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
 
     new_depth = st.depth + (ok & is_jumpi).astype(jnp.int32)
 
+    # function-entry tracking: jumps landing on a selector-dispatch
+    # target update the lane's active function (the fall-through fork
+    # child keeps the old value — restored in _do_forks)
+    jumped = ok & (
+        is_jump | (is_jumpi & ~sym_b & jumpi_taken_conc) | fork_can
+    )
+    dest_c2 = jnp.clip(dest, 0, code.size)
+    new_fentry = jnp.where(
+        jumped & code.is_func_entry[dest_c2], dest, st.fentry
+    )
+
     # ---- path-condition append (parent side: condition holds) -------------
     def _pclog_append():
         pos = jnp.clip(st.pclog_count, 0, p_recs - 1)
@@ -760,11 +814,12 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
         pc=jnp.where(ok, new_pc, st.pc),
         sp=jnp.where(ok, new_sp, st.sp),
         depth=new_depth,
+        fentry=new_fentry,
         stack=stack,
         ssid=ssid,
         memory=memory,
+        mkind=mkind2,
         msize=msize2,
-        msym=msym2,
         mlog_off=mlog_off2,
         mlog_len=mlog_len2,
         mlog_sid=mlog_sid2,
@@ -821,10 +876,13 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
         s2 = SymLaneState(
             **{f: copy_rows(f, getattr(s, f)) for f in s._fields}
         )
-        # child diverges: fall-through pc, negated path condition
+        # child diverges: fall-through pc, negated path condition; it
+        # did not take the jump, so it keeps the pre-step function entry
         fall_pc = next_pc[parent_c]
         s2 = s2._replace(
             pc=s2.pc.at[child_rows].set(fall_pc, mode="drop"),
+            fentry=s2.fentry.at[child_rows].set(
+                st.fentry[parent_c], mode="drop"),
             pclog_neg=s2.pclog_neg.at[
                 child_rows,
                 jnp.clip(s2.pclog_count[parent_c] - 1, 0, p_recs - 1),
@@ -850,10 +908,12 @@ def sym_step(code: CompiledCode, st: SymLaneState) -> SymLaneState:
     return out
 
 
-def sym_run(code: CompiledCode, st: SymLaneState,
-            max_steps: int) -> SymLaneState:
+def sym_run(code: CompiledCode, st: SymLaneState, max_steps: int,
+            exec_table: jnp.ndarray = None) -> SymLaneState:
     """Run up to max_steps (one sync window). max_steps must not exceed
     the deferred-log capacity (one record per lane per step)."""
+    if exec_table is None:
+        exec_table = SYM_EXECUTABLE
 
     def cond(carry):
         s, i = carry
@@ -861,7 +921,7 @@ def sym_run(code: CompiledCode, st: SymLaneState,
 
     def body(carry):
         s, i = carry
-        return sym_step(code, s), i + 1
+        return sym_step(code, s, exec_table), i + 1
 
     final, _ = lax.while_loop(cond, body, (st, jnp.int32(0)))
     return final

@@ -419,13 +419,26 @@ def mk_concat(*parts: Term) -> Term:
             flat.extend(p.args)
         else:
             flat.append(p)
-    # merge adjacent constants
+    # merge adjacent constants and adjacent extracts of one base term
+    # (concat(extract(h,m+1,x), extract(m,l,x)) == extract(h,l,x) — the
+    # shape byte-granular memory reads of a stored word produce)
     merged = []
     for p in flat:
         if merged and is_const(merged[-1]) and is_const(p):
             prev = merged.pop()
             merged.append(
                 bv_const((prev.val << p.width) | p.val, prev.width + p.width)
+            )
+        elif (
+            merged
+            and merged[-1].op == EXTRACT
+            and p.op == EXTRACT
+            and merged[-1].args[0] is p.args[0]
+            and merged[-1].params[1] == p.params[0] + 1
+        ):
+            prev = merged.pop()
+            merged.append(
+                mk_extract(prev.params[0], p.params[1], p.args[0])
             )
         else:
             merged.append(p)
@@ -834,7 +847,13 @@ def _eval_node(t: Term, env: EvalEnv, memo):
 
 def substitute_term(t: Term, mapping: Dict[int, Term], memo=None) -> Term:
     """Replace subterms by tid -> replacement. Rebuilds with folding.
-    Iterative post-order (deep chains exceed the recursion limit)."""
+    Iterative post-order (deep chains exceed the recursion limit).
+
+    Empty mapping is an identity: every term is built through the
+    normalizing mk_* constructors, so a rules-only rebuild returns the
+    same interned node — simplify() rides this shortcut."""
+    if not mapping:
+        return t
     if memo is None:
         memo = {}
 

@@ -1,0 +1,90 @@
+"""Lane engine end-to-end through the full analyzer: reports produced
+with the TPU lane sweep enabled must equal the host-only reports on the
+reference's own analysis fixtures (same oracles as
+test_analysis_accuracy.py)."""
+
+import json
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+from mythril_tpu.orchestration.mythril_disassembler import (
+    MythrilDisassembler,
+)
+from mythril_tpu.support.support_args import args as global_args
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+
+# fixtures whose module sets leave the device free to fork (no JUMPI
+# hook): EtherThief (post CALL/STATICCALL), AccidentallyKillable
+# (pre SELFDESTRUCT), ArbitraryStorage (pre SSTORE)
+CASES = [
+    ("flag_array.sol.o", "EtherThief", 1, 1),
+    ("symbolic_exec_bytecode.sol.o", "AccidentallyKillable", 1, 1),
+]
+
+
+def _analyze(file_name, module, tx_count, tpu_lanes):
+    disassembler = MythrilDisassembler(eth=None)
+    code = (INPUTS / file_name).read_text().strip()
+    address, _ = disassembler.load_from_bytecode(code, bin_runtime=False)
+    cmd_args = SimpleNamespace(
+        execution_timeout=300,
+        max_depth=128,
+        solver_timeout=60000,
+        no_onchain_data=True,
+        loop_bound=3,
+        create_timeout=10,
+        pruning_factor=None,
+        unconstrained_storage=False,
+        parallel_solving=False,
+        call_depth_limit=3,
+        disable_dependency_pruning=False,
+        custom_modules_directory="",
+        solver_log=None,
+        transaction_sequences=None,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+    old = global_args.tpu_lanes
+    global_args.tpu_lanes = tpu_lanes
+    try:
+        report = analyzer.fire_lasers(
+            modules=[module], transaction_count=tx_count)
+    finally:
+        global_args.tpu_lanes = old
+    return json.loads(report.as_swc_standard_format())
+
+
+def _strip_volatile(obj):
+    """Remove wall-clock fields from a report structure in place."""
+    if isinstance(obj, dict):
+        obj.pop("discoveryTime", None)
+        for v in obj.values():
+            _strip_volatile(v)
+    elif isinstance(obj, list):
+        for v in obj:
+            _strip_volatile(v)
+    return obj
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+@pytest.mark.parametrize("file_name,module,tx_count,issue_count", CASES)
+def test_lane_report_parity(file_name, module, tx_count, issue_count):
+    host = _strip_volatile(_analyze(file_name, module, tx_count,
+                                    tpu_lanes=0))
+    lane = _strip_volatile(_analyze(file_name, module, tx_count,
+                                    tpu_lanes=64))
+    assert host == lane, (
+        f"report divergence with lane engine on {file_name}:\n"
+        f"host: {json.dumps(host, indent=1)}\n"
+        f"lane: {json.dumps(lane, indent=1)}"
+    )
+    issues = sum(len(v.get("issues", [])) for v in lane.values()) \
+        if isinstance(lane, dict) else None
+    if issues is not None and issue_count is not None:
+        assert issues == issue_count
