@@ -149,6 +149,13 @@ _ALU2 = {
 }
 _ALU3 = {"ADDMOD": alu.addmod, "MULMOD": alu.mulmod}
 
+# pop arity per deferrable op (memo keys must ignore the unused operand
+# slots — they hold whatever sat below the live operands on the stack)
+_ARITY = {name: 2 for name in _ALU2}
+_ARITY.update({name: 3 for name in _ALU3})
+_ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
+               "SLOAD": 1, "CALLDATALOAD": 1})
+
 
 class LaneEngine:
     """Owns one lane batch + object table for a single contract's
@@ -176,6 +183,7 @@ class LaneEngine:
         # to the same word term; building it once matters (32 If+select
         # terms per word)
         self._cdl_cache: Dict[Tuple[int, int], BitVec] = {}
+        self._record_memo: Dict[tuple, int] = {}
         self.stats = {
             "seeded": 0, "forks": 0, "records": 0, "parked": 0,
             "dead": 0, "device_steps": 0, "windows": 0,
@@ -454,19 +462,43 @@ class LaneEngine:
             opname = _OPN[int(h["dlog_op"][row, k])]
             sids = h["dlog_sid"][row, k]
             vals = h["dlog_val"][row, k]
-            args = [
-                self._resolve_arg(int(sids[j]), vals[j], prov, d_recs)
-                for j in range(3)
-            ]
-            obj = self._resolve_record(ctxs[lane], opname, args)
-            # sids model stack slots: apply MachineStack.append's
-            # coercion (state/machine_state.py — Bool/int pushes are
-            # wrapped into 256-bit BitVecs)
-            if isinstance(obj, Bool):
-                obj = If(obj, _bv_val(1), _bv_val(0))
-            elif isinstance(obj, int):
-                obj = _bv_val(obj)
-            prov[(lane, k)] = self.objects.add(obj)
+            # dedup identical records across lanes: forked paths
+            # recompute the same terms in lockstep, and one resolution
+            # (one shared wrapper — host parity: sibling states share
+            # stack wrappers via MachineStack's shallow copy) serves all
+            key_parts = [opname]
+            for j in range(_ARITY[opname]):
+                sid = int(sids[j])
+                if sid == 0:
+                    key_parts.append(("c", _limbs_int(vals[j])))
+                elif sid > 0:
+                    key_parts.append(("o", sid))
+                else:
+                    idx = -sid - 1
+                    key_parts.append(
+                        ("o", prov[(idx // d_recs, idx % d_recs)]))
+            # SLOAD/CALLDATALOAD resolve against per-seed context
+            if opname in ("SLOAD", "CALLDATALOAD"):
+                key_parts.append(("ctx", id(ctxs[lane].template)))
+            key = tuple(key_parts)
+            oid = self._record_memo.get(key)
+            if oid is None:
+                args = [
+                    self._resolve_arg(int(sids[j]), vals[j], prov,
+                                      d_recs)
+                    for j in range(3)
+                ]
+                obj = self._resolve_record(ctxs[lane], opname, args)
+                # sids model stack slots: apply MachineStack.append's
+                # coercion (state/machine_state.py — Bool/int pushes
+                # are wrapped into 256-bit BitVecs)
+                if isinstance(obj, Bool):
+                    obj = If(obj, _bv_val(1), _bv_val(0))
+                elif isinstance(obj, int):
+                    obj = _bv_val(obj)
+                oid = self.objects.add(obj)
+                self._record_memo[key] = oid
+            prov[(lane, k)] = oid
         self.stats["records"] += len(recs)
 
         # 3. path conditions -> ctx.conds (jumpi_ handler semantics)

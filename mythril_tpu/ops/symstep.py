@@ -374,51 +374,68 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     )
     exp_pure = ~sym_a & (a_popcount <= 1)
 
-    # ---- memory overlay decisions (MLOAD) ---------------------------------
-    # the kind plane decides concrete vs symbolic reads; the overlay log
-    # (symbolic word stores only, in program order) supplies the sid for
-    # an exact all-symbolic hit
+    # ---- memory overlay decisions (MLOAD) — gated: the kind-plane
+    # gather and overlay scans read O(N*32 + N*MR) every evaluation ------
     byte_idx32 = mem_off[:, None] + jnp.arange(32)[None, :]
     byte_idx32_c = jnp.clip(byte_idx32, 0, mem_bytes - 1)
-    kinds32 = jnp.take_along_axis(st.mkind, byte_idx32_c, axis=1)
-    any_sym_byte = jnp.any(kinds32 == KIND_SYM_WORD, axis=1)
-    all_sym_byte = jnp.all(kinds32 == KIND_SYM_WORD, axis=1)
-
-    rec_ids = jnp.arange(mem_recs)[None, :]
-    live_rec = rec_ids < st.mlog_count[:, None]
-    ov_sym = (
-        live_rec
-        & (st.mlog_off < mem_end[:, None])
-        & ((st.mlog_off + st.mlog_len) > mem_off[:, None])
-    )
-    last_sym = jnp.max(jnp.where(ov_sym, rec_ids + 1, 0), axis=1) - 1
-    ls_c = jnp.clip(last_sym, 0, mem_recs - 1)
-    ls_off = _gather_flat(st.mlog_off, ls_c)
-    ls_len = _gather_flat(st.mlog_len, ls_c)
-    ls_sid = _gather_flat(st.mlog_sid, ls_c)
-    top_sym_exact = (
-        all_sym_byte & (last_sym >= 0)
-        & (ls_off == mem_off) & (ls_len == 32)
-    )
-    mload_sym_sid = jnp.where(top_sym_exact, ls_sid, 0)
-    mload_conc_ok = ~any_sym_byte
-    mload_park = is_mload & ~sym_a & ~mem_oob \
-        & ~(top_sym_exact | mload_conc_ok)
-
-    # MSTORE of a symbolic word appends an overlay record
     sym_store_val = is_mstore & sym_b
+
+    def _mem_decisions():
+        # the kind plane decides concrete vs symbolic reads; the overlay
+        # log (symbolic word stores only, in program order) supplies the
+        # sid for an exact all-symbolic hit
+        kinds32 = jnp.take_along_axis(st.mkind, byte_idx32_c, axis=1)
+        any_sym_byte = jnp.any(kinds32 == KIND_SYM_WORD, axis=1)
+        all_sym_byte = jnp.all(kinds32 == KIND_SYM_WORD, axis=1)
+
+        rec_ids = jnp.arange(mem_recs)[None, :]
+        live_rec = rec_ids < st.mlog_count[:, None]
+        ov_sym = (
+            live_rec
+            & (st.mlog_off < mem_end[:, None])
+            & ((st.mlog_off + st.mlog_len) > mem_off[:, None])
+        )
+        last_sym = jnp.max(jnp.where(ov_sym, rec_ids + 1, 0), axis=1) - 1
+        ls_c = jnp.clip(last_sym, 0, mem_recs - 1)
+        ls_off = _gather_flat(st.mlog_off, ls_c)
+        ls_len = _gather_flat(st.mlog_len, ls_c)
+        ls_sid = _gather_flat(st.mlog_sid, ls_c)
+        exact = (
+            all_sym_byte & (last_sym >= 0)
+            & (ls_off == mem_off) & (ls_len == 32)
+        )
+        sym_sid = jnp.where(exact, ls_sid, 0)
+        park_ = is_mload & ~sym_a & ~mem_oob \
+            & ~(exact | ~any_sym_byte)
+        return exact, sym_sid, park_
+
+    top_sym_exact, mload_sym_sid, mload_park = lax.cond(
+        jnp.any(running & mem_ops),
+        _mem_decisions,
+        lambda: (zero_b, zero_i, zero_b),
+    )
+    # MSTORE of a symbolic word appends an overlay record
     mlog_full = sym_store_val & (st.mlog_count >= mem_recs)
 
-    # ---- storage decisions -------------------------------------------------
-    slot_ids = jnp.arange(s_slots)[None, :]
-    key_match = jnp.all(st.skeys == a[:, None, :], axis=-1) \
-        & (slot_ids < st.scount[:, None])
-    match_score = jnp.where(key_match, slot_ids + 1, 0)
-    best = jnp.max(match_score, axis=1)
-    s_found = best > 0
-    s_idx = jnp.clip(best - 1, 0, s_slots - 1)
-    sload_hit_val = _onehot_gather(st.svals, s_idx)
-    sload_hit_sid = _gather_flat(st.sval_sid, s_idx)
+    # ---- storage decisions (gated: the key compare reads the whole
+    # (N,S,8) log every evaluation) -----------------------------------------
+    def _storage_decisions():
+        slot_ids = jnp.arange(s_slots)[None, :]
+        key_match = jnp.all(st.skeys == a[:, None, :], axis=-1) \
+            & (slot_ids < st.scount[:, None])
+        match_score = jnp.where(key_match, slot_ids + 1, 0)
+        best = jnp.max(match_score, axis=1)
+        found = best > 0
+        idx = jnp.clip(best - 1, 0, s_slots - 1)
+        return (found, idx, _onehot_gather(st.svals, idx),
+                _gather_flat(st.sval_sid, idx))
+
+    any_storage_op = jnp.any(running & (is_sload | is_sstore))
+    s_found, s_idx, sload_hit_val, sload_hit_sid = lax.cond(
+        any_storage_op,
+        _storage_decisions,
+        lambda: (zero_b, zero_i, zero_w, zero_i),
+    )
     sload_miss = is_sload & ~sym_a & ~s_found
     # misses against a symbolic base defer to a select() term; misses
     # against the zero K-array are concrete 0 — both are cached in the
@@ -740,24 +757,19 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     ssid = _scatter_flat(ssid, do_swap, top_idx, swap_sid)
     ssid = _scatter_flat(ssid, do_swap, swap_idx, sid_a)
 
-    # ---- deferred-record append -------------------------------------------
+    # ---- deferred-record append (indexed row scatter: a dense one-hot
+    # select would rewrite the whole (N,R,3,8) log plane every step) ------
     def _dlog_append():
-        pos = jnp.clip(st.dlog_count, 0, d_recs - 1)
-        dop = _scatter_flat(st.dlog_op, defer, pos, op)
-        dpc = _scatter_flat(st.dlog_pc, defer, pos, st.pc)
-        dstep = _scatter_flat(
-            st.dlog_step, defer, pos,
-            jnp.full((n,), st.step_no, jnp.int32))
+        pos = jnp.where(defer, jnp.clip(st.dlog_count, 0, d_recs - 1),
+                        d_recs)  # drop for non-deferring lanes
+        dop = st.dlog_op.at[lanes, pos].set(op, mode="drop")
+        dpc = st.dlog_pc.at[lanes, pos].set(st.pc, mode="drop")
+        dstep = st.dlog_step.at[lanes, pos].set(
+            jnp.full((n,), st.step_no, jnp.int32), mode="drop")
         sids = jnp.stack([sid_a, sid_b, sid_c], axis=-1)  # (N, 3)
         vals = jnp.stack([a, b, c], axis=1)               # (N, 3, 8)
-        onehot = (
-            (jnp.arange(d_recs)[None, :] == pos[:, None])
-            & defer[:, None]
-        )
-        dsid = jnp.where(onehot[:, :, None], sids[:, None, :],
-                         st.dlog_sid)
-        dval = jnp.where(onehot[:, :, None, None], vals[:, None, :, :],
-                         st.dlog_val)
+        dsid = st.dlog_sid.at[lanes, pos].set(sids, mode="drop")
+        dval = st.dlog_val.at[lanes, pos].set(vals, mode="drop")
         dcount = jnp.where(defer, st.dlog_count + 1, st.dlog_count)
         return dop, dpc, dstep, dsid, dval, dcount
 
