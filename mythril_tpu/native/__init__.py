@@ -77,13 +77,47 @@ def get_lib() -> ctypes.CDLL:
         lib.mtpu_sat_value.restype = ctypes.c_int32
         lib.mtpu_sat_stats.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.mtpu_sat_stats.restype = ctypes.c_int64
+        # blaster bindings are optional: a stale library without them
+        # must still serve SAT/keccak (make_blaster falls back to the
+        # Python Blaster when the symbols are absent)
+        try:
+            lib.mtpu_blaster_new.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)
+            ]
+            lib.mtpu_blaster_new.restype = ctypes.c_void_p
+            lib.mtpu_blaster_free.argtypes = [ctypes.c_void_p]
+            lib.mtpu_blaster_exec.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.mtpu_blaster_exec.restype = ctypes.c_int32
+            lib.mtpu_blaster_bool_lit.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32
+            ]
+            lib.mtpu_blaster_bool_lit.restype = ctypes.c_int32
+            lib.mtpu_blaster_get_bits.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ]
+            lib.mtpu_blaster_get_bits.restype = ctypes.c_int32
+            lib.mtpu_blaster_ult.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.mtpu_blaster_ult.restype = ctypes.c_int32
+        except AttributeError:
+            log.warning(
+                "native library lacks blaster symbols; Python "
+                "bit-blaster fallback in effect"
+            )
         _lib = lib
         return _lib
 
 
 def _needs_rebuild() -> bool:
     so_mtime = os.path.getmtime(_LIB_PATH)
-    for src in ("sat.cpp", "keccak.cpp"):
+    for src in ("sat.cpp", "keccak.cpp", "blaster.cpp"):
         if os.path.getmtime(os.path.join(_HERE, src)) > so_mtime:
             return True
     return False

@@ -470,3 +470,320 @@ class Blaster:
     def _lit_val(self, l: int) -> bool:
         val = self.sat.value(abs(l))
         return val if l > 0 else (not val)
+
+
+# ---------------------------------------------------------------------------
+# native term-tape blaster
+# ---------------------------------------------------------------------------
+
+# tape opcodes (keep in sync with mythril_tpu/native/blaster.cpp TapeOp)
+(TP_CONST, TP_VAR, TP_ADD, TP_SUB, TP_MUL, TP_UDIV, TP_UREM, TP_SDIV,
+ TP_SREM, TP_BAND, TP_BOR, TP_BXOR, TP_BNOT, TP_NEG, TP_SHL, TP_LSHR,
+ TP_ASHR, TP_CONCAT, TP_EXTRACT, TP_ZEXT, TP_SEXT, TP_ITE) = range(1, 23)
+TP_TRUE, TP_FALSE, TP_BOOLVAR, TP_EQ_BV, TP_EQ_BOOL, TP_ULT, TP_ULE, \
+    TP_SLT, TP_SLE, TP_AND_B, TP_OR_B, TP_NOT_B, TP_XOR_B, TP_BITE = \
+    range(30, 44)
+TP_ASSERT = 50
+
+_BV_BINOP = {
+    T.ADD: TP_ADD, T.SUB: TP_SUB, T.MUL: TP_MUL, T.UDIV: TP_UDIV,
+    T.UREM: TP_UREM, T.SDIV: TP_SDIV, T.SREM: TP_SREM, T.BAND: TP_BAND,
+    T.BOR: TP_BOR, T.BXOR: TP_BXOR, T.SHL: TP_SHL, T.LSHR: TP_LSHR,
+    T.ASHR: TP_ASHR,
+}
+_BOOL_CMP = {T.ULT: TP_ULT, T.ULE: TP_ULE, T.SLT: TP_SLT, T.SLE: TP_SLE}
+
+
+class NativeBlaster:
+    """Drop-in replacement for Blaster executing the word-level encoding
+    in C++ (native/blaster.cpp). The tape is a faithful serialization of
+    the same post-order walk Blaster._ensure_blasted performs, and the
+    C++ side is a gate-for-gate port, so the emitted CNF stream — and
+    therefore the CDCL search, results and models — is identical to the
+    Python blaster's. Per-gate Python overhead (the dominant solver-side
+    cost) collapses into one FFI crossing per assertion batch.
+
+    `_bv`/`_bool` are membership maps (tid -> True) kept for the model
+    extractor's scope filtering; literal vectors live in C++."""
+
+    def __init__(self, sat):
+        import ctypes
+
+        from ..native import get_lib
+
+        self.sat = sat
+        self._lib = get_lib()
+        self._nv = ctypes.c_int64(sat.nvars)
+        # creation order parity: Python Blaster buffers [T] before any
+        # other clause — flush pending clauses, then let C++ emit
+        sat.flush()
+        self.T = sat.nvars + 1  # the var the C++ side allocates first
+        self._h = self._lib.mtpu_blaster_new(
+            sat._h, ctypes.byref(self._nv))
+        sat.nvars = self._nv.value
+        self.F = -self.T
+        self._bv: Dict[int, bool] = {}
+        self._bool: Dict[int, bool] = {}
+        self._bool_lits: Dict[int, int] = {}
+        self._pending_bv: List[int] = []
+        self._pending_bool: List[int] = []
+        self._ctypes = ctypes
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.mtpu_blaster_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # -- tape construction --------------------------------------------------
+
+    def _append_term(self, tape, t):
+        op = t.op
+        tid = t.tid
+        if t.is_bool:
+            if op == T.TRUE:
+                tape += (TP_TRUE, tid)
+            elif op == T.FALSE:
+                tape += (TP_FALSE, tid)
+            elif op == T.BOOL_VAR:
+                tape += (TP_BOOLVAR, tid)
+            elif op == T.EQ:
+                if t.args[0].is_array or t.args[1].is_array:
+                    # parity with Blaster.bool_lit -> eq_vec -> bits():
+                    # array terms cannot be blasted; raising here keeps
+                    # the tape free of undefined operand references
+                    raise NotImplementedError(
+                        "array equality must be eliminated before "
+                        "blasting")
+                if t.args[0].is_bool:
+                    tape += (TP_EQ_BOOL, tid, t.args[0].tid,
+                             t.args[1].tid)
+                else:
+                    tape += (TP_EQ_BV, tid, t.args[0].tid, t.args[1].tid)
+            elif op in _BOOL_CMP:
+                tape += (_BOOL_CMP[op], tid, t.args[0].tid,
+                         t.args[1].tid)
+            elif op == T.AND:
+                tape += (TP_AND_B, tid, len(t.args))
+                tape += tuple(a.tid for a in t.args)
+            elif op == T.OR:
+                tape += (TP_OR_B, tid, len(t.args))
+                tape += tuple(a.tid for a in t.args)
+            elif op == T.NOT:
+                tape += (TP_NOT_B, tid, t.args[0].tid)
+            elif op == T.XOR:
+                tape += (TP_XOR_B, tid, t.args[0].tid, t.args[1].tid)
+            elif op == T.BOOL_ITE:
+                tape += (TP_BITE, tid, t.args[0].tid, t.args[1].tid,
+                         t.args[2].tid)
+            else:
+                raise NotImplementedError(f"bool op {op}")
+            self._pending_bool.append(tid)
+            return
+        w = t.width
+        if op == T.BV_CONST:
+            nwords = (w + 31) // 32
+            tape += (TP_CONST, tid, w, nwords)
+            v = t.val
+            tape += tuple((v >> (32 * i)) & 0xFFFFFFFF
+                          for i in range(nwords))
+        elif op == T.BV_VAR:
+            tape += (TP_VAR, tid, w)
+        elif op in _BV_BINOP:
+            tape += (_BV_BINOP[op], tid, w, t.args[0].tid, t.args[1].tid)
+        elif op == T.BNOT:
+            tape += (TP_BNOT, tid, w, t.args[0].tid)
+        elif op == T.NEG:
+            tape += (TP_NEG, tid, w, t.args[0].tid)
+        elif op == T.CONCAT:
+            tape += (TP_CONCAT, tid, w, len(t.args))
+            tape += tuple(a.tid for a in t.args)
+        elif op == T.EXTRACT:
+            hi, lo = t.params
+            tape += (TP_EXTRACT, tid, w, t.args[0].tid, hi, lo)
+        elif op == T.ZEXT:
+            tape += (TP_ZEXT, tid, w, t.args[0].tid, t.params[0])
+        elif op == T.SEXT:
+            tape += (TP_SEXT, tid, w, t.args[0].tid, t.params[0])
+        elif op == T.ITE:
+            tape += (TP_ITE, tid, w, t.args[0].tid, t.args[1].tid,
+                     t.args[2].tid)
+        else:
+            raise NotImplementedError(
+                f"bv op {op} (arrays/UF must be eliminated before "
+                "blasting)")
+        self._pending_bv.append(tid)
+
+    def _tape_for(self, t, tape):
+        """Append post-order entries for t's not-yet-blasted cone (the
+        same walk as Blaster._ensure_blasted). Terms are only marked
+        blasted after the tape EXECUTES successfully (_exec) — a
+        NotImplementedError mid-serialization must not poison the
+        session with marked-but-never-blasted tids."""
+        self._pending_bv.clear()
+        self._pending_bool.clear()
+        known_bv, known_bool = self._bv, self._bool
+        stack = [t]
+        done = set()
+        while stack:
+            cur = stack[-1]
+            tid = cur.tid
+            if tid in done or tid in known_bv or tid in known_bool:
+                stack.pop()
+                continue
+            if cur.is_array:
+                done.add(tid)
+                stack.pop()
+                continue
+            pending = [
+                a for a in cur.args
+                if a.tid not in done and a.tid not in known_bv
+                and a.tid not in known_bool
+            ]
+            if pending:
+                stack.extend(pending)
+                continue
+            stack.pop()
+            done.add(tid)
+            if not cur.is_array:
+                self._append_term(tape, cur)
+
+    def _exec(self, tape):
+        import array
+
+        if not tape:
+            self._pending_bv.clear()
+            self._pending_bool.clear()
+            return
+        ct = self._ctypes
+        buf = array.array("i", [x - (1 << 32) if x >= (1 << 31) else x
+                                for x in tape])
+        addr, n = buf.buffer_info()
+        # clause-order parity: earlier Python-side adds go first
+        self.sat.flush()
+        self._nv.value = self.sat.nvars
+        r = self._lib.mtpu_blaster_exec(
+            self._h, ct.cast(addr, ct.POINTER(ct.c_int32)), n,
+            ct.byref(self._nv))
+        self.sat.nvars = self._nv.value
+        if r == -2:
+            self._pending_bv.clear()
+            self._pending_bool.clear()
+            raise RuntimeError("malformed blaster tape")
+        # success (or latched-unsat): the tape's terms are now blasted
+        for tid in self._pending_bv:
+            self._bv[tid] = True
+        for tid in self._pending_bool:
+            self._bool[tid] = True
+        self._pending_bv.clear()
+        self._pending_bool.clear()
+        if r == -1:
+            self.sat._latched_unsat = True
+
+    # -- Blaster-compatible interface ----------------------------------------
+
+    def _ensure_blasted(self, t) -> None:
+        tape = []
+        self._tape_for(t, tape)
+        self._exec(tape)
+
+    def bool_lit(self, t) -> int:
+        lit = self._bool_lits.get(t.tid)
+        if lit is not None:
+            return lit
+        if t.tid not in self._bool:
+            self._ensure_blasted(t)
+        lit = self._lib.mtpu_blaster_bool_lit(self._h, t.tid)
+        assert lit != 0, f"term {t.tid} not blasted"
+        self._bool_lits[t.tid] = lit
+        return lit
+
+    def bits(self, t) -> List[int]:
+        if t.tid not in self._bv:
+            self._ensure_blasted(t)
+        ct = self._ctypes
+        cap = 1024
+        while True:
+            out = (ct.c_int32 * cap)()
+            w = self._lib.mtpu_blaster_get_bits(self._h, t.tid, out,
+                                                cap)
+            assert w >= 0, f"term {t.tid} not blasted"
+            if w <= cap:
+                return list(out[:w])
+            cap = w  # wide concats (e.g. long keccak inputs): retry
+
+    def assert_term(self, t) -> None:
+        if t.op == T.AND:
+            for a in t.args:
+                self.assert_term(a)
+            return
+        tape = []
+        self._tape_for(t, tape)
+        tape.append(TP_ASSERT)
+        tape.append(t.tid)
+        self._exec(tape)
+
+    def model_value(self, t) -> int:
+        if t.is_bool:
+            if t.tid not in self._bool:
+                return 0
+            return 1 if self._lit_val(self.bool_lit(t)) else 0
+        if t.tid not in self._bv:
+            return 0
+        v = 0
+        for i, l in enumerate(self.bits(t)):
+            if self._lit_val(l):
+                v |= 1 << i
+        return v
+
+    def _lit_val(self, l: int) -> bool:
+        val = self.sat.value(abs(l))
+        return val if l > 0 else (not val)
+
+    # gate-level helpers the Optimize binary search uses
+    def is_true(self, l) -> bool:
+        return l == self.T
+
+    def is_false(self, l) -> bool:
+        return l == self.F
+
+    def const_bits(self, value: int, width: int) -> List[int]:
+        return [self.T if (value >> i) & 1 else self.F
+                for i in range(width)]
+
+    def ult_vec(self, a, b) -> int:
+        ct = self._ctypes
+        n = min(len(a), len(b))
+        aa = (ct.c_int32 * n)(*a[:n])
+        bb = (ct.c_int32 * n)(*b[:n])
+        self.sat.flush()
+        self._nv.value = self.sat.nvars
+        lit = self._lib.mtpu_blaster_ult(
+            self._h, aa, bb, n, ct.byref(self._nv))
+        self.sat.nvars = self._nv.value
+        return lit
+
+
+import os as _os
+
+_FORCE_PY = _os.environ.get("MTPU_PY_BLASTER") == "1"
+_native_ok = None
+
+
+def make_blaster(sat):
+    """Native term-tape blaster when the shared library is available,
+    Python fallback otherwise (or with MTPU_PY_BLASTER=1)."""
+    global _native_ok
+    if _FORCE_PY:
+        return Blaster(sat)
+    if _native_ok is None:
+        try:
+            from ..native import get_lib
+
+            lib = get_lib()
+            _native_ok = hasattr(lib, "mtpu_blaster_new")
+        except Exception:
+            _native_ok = False
+    return NativeBlaster(sat) if _native_ok else Blaster(sat)

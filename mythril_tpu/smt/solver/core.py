@@ -22,7 +22,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import terms as T
-from ..bitblast import Blaster
+from ..bitblast import make_blaster
 from ..interval import interval as abs_interval
 from ...native import SatSolver
 
@@ -241,7 +241,7 @@ class _IncrementalSession:
 
     def __init__(self):
         self.sat = SatSolver()
-        self.blaster = Blaster(self.sat)
+        self.blaster = make_blaster(self.sat)
         # ackermannization state shared across queries
         self.ack_cache: Dict[int, "T.Term"] = {}  # select/apply tid -> var
         self.select_map: Dict[str, list] = {}
@@ -442,7 +442,7 @@ def check(
         return ctx
 
     sat = SatSolver()
-    blaster = Blaster(sat)
+    blaster = make_blaster(sat)
     for a in work:
         blaster.assert_term(a)
 
