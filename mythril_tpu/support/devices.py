@@ -1,0 +1,63 @@
+"""JAX device-platform setup shared by the test suite and the driver
+entry points.
+
+The environment's sitecustomize pre-imports jax against a single real
+tunneled TPU chip, so plain env vars (XLA_FLAGS / JAX_PLATFORMS) are not
+enough to get a multi-device virtual CPU mesh: jax.config must be
+updated, and if a backend was already initialized it must be torn down
+first (including the separate @util.cache on xla_bridge.get_backend,
+which _clear_backends does not clear).
+"""
+
+import os
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Rebuild JAX as an n-device virtual CPU platform, tearing down any
+    already-initialized backend."""
+    import jax
+    from jax._src import xla_bridge as xb
+
+    if getattr(xb, "_backends", None):
+        xb._clear_backends()
+        if hasattr(xb.get_backend, "cache_clear"):
+            xb.get_backend.cache_clear()
+
+    # XLA_FLAGS is parsed once per process, so it only helps when no
+    # client was ever created; jax_num_cpu_devices covers re-init after
+    # a first (real-chip) client already consumed the flags.
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + flag
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:
+        pass  # older jax: XLA_FLAGS alone covers it
+
+
+def ensure_devices(n_devices: int) -> None:
+    """Use the real backend if it provides n working devices; otherwise
+    force an n-device virtual CPU platform.
+
+    "Working" is probed with an actual op: device *enumeration* can
+    succeed while execution is broken (e.g. a libtpu client/terminal
+    version mismatch fails only at the first executed primitive).
+    """
+    import jax
+
+    try:
+        if len(jax.devices()) >= n_devices:
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jnp.zeros(()) + 1)
+            return
+    except Exception:
+        pass  # unusable device plugin — fall through to virtual CPU
+
+    force_virtual_cpu(n_devices)
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} devices, have {len(jax.devices())} "
+        f"({jax.devices()[0].platform})"
+    )

@@ -10,19 +10,15 @@ import sys
 # every test compile against it and hide multi-device sharding bugs. The
 # backend is still uninitialized at conftest time, so jax.config wins. The
 # driver exercises the real-chip path separately via __graft_entry__.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mythril_tpu.support.devices import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
 
 import jax  # noqa: E402  (pre-imported by sitecustomize; config still open)
-
-jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the interval/stepper kernels compile in
 # tens of seconds; caching them across test runs keeps the suite fast.
 jax.config.update("jax_compilation_cache_dir", "/tmp/mythril_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
