@@ -156,6 +156,22 @@ class SymExecWrapper:
                 for m in cb_modules
             ):
                 lane_engine_active = False
+        if lane_engine_active:
+            # probe availability with an actual op (device enumeration
+            # can succeed while execution is broken): if the sweep would
+            # bail at runtime, the host path must not silently run
+            # without the pruner
+            try:
+                from ..laser.lane_engine import LaneEngine  # noqa: F401
+                import jax
+                import jax.numpy as jnp
+
+                jax.block_until_ready(jnp.zeros(()) + 1)
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "lane engine unavailable (%s); host pruners kept", e
+                )
+                lane_engine_active = False
         if not disable_dependency_pruning and not lane_engine_active:
             plugin_loader.load(DependencyPrunerBuilder())
         plugin_loader.instrument_virtual_machine(self.laser, None)

@@ -324,6 +324,7 @@ class LaneEngine:
             mlog_count=st.mlog_count.at[idx].set(0),
             sval_sid=st.sval_sid.at[idx].set(0),
             s_written=st.s_written.at[idx].set(0),
+            s_read=st.s_read.at[idx].set(0),
             scount=st.scount.at[idx].set(0),
             sbase=st.sbase.at[idx].set(col("sbase", np.int32)),
             calldata=st.calldata.at[idx].set(
@@ -626,14 +627,19 @@ class LaneEngine:
                     ms.memory[i] = simplify(
                         Extract(255 - 8 * j, 248 - 8 * j, obj))
 
-        # storage: read-cache entries repopulate keys_get, written
-        # entries replay as stores
+        # storage: replay reads/writes in keys_get/keys_set parity order
+        # — the interpreter records *every* read, so a slot read before
+        # its first write (s_read bit 1) replays a read ahead of the
+        # store, and one read after a write (bit 2) replays one behind
         acct = gs.environment.active_account
         any_written = False
         for r in range(int(st_host["scount"][lane])):
             key = _bv_val(_limbs_int(st_host["skeys"][lane, r]))
             written = int(st_host["s_written"][lane, r])
+            sread = int(st_host["s_read"][lane, r])
             sid = int(st_host["sval_sid"][lane, r])
+            if sread & 1:
+                _ = acct.storage[key]
             if written:
                 any_written = True
                 if sid:
@@ -641,7 +647,7 @@ class LaneEngine:
                 else:
                     acct.storage[key] = _bv_val(
                         _limbs_int(st_host["svals"][lane, r]))
-            else:
+            if sread & 2:
                 _ = acct.storage[key]
         if any_written:
             # device-executed SSTOREs must leave the same mark the
@@ -729,6 +735,7 @@ class LaneEngine:
                     "skeys": st.skeys[ridx], "svals": st.svals[ridx],
                     "sval_sid": st.sval_sid[ridx],
                     "s_written": st.s_written[ridx],
+                    "s_read": st.s_read[ridx],
                     "scount": st.scount[ridx],
                     "min_gas": st.min_gas[ridx],
                     "max_gas": st.max_gas[ridx],

@@ -154,6 +154,9 @@ class SymLaneState(NamedTuple):
     svals: jnp.ndarray         # (N, S, 8) u32
     sval_sid: jnp.ndarray      # (N, S) i32
     s_written: jnp.ndarray     # (N, S) i32 (1 = SSTORE, 0 = read cache)
+    s_read: jnp.ndarray        # (N, S) i32 bitmask: 1 = read before any
+    #                            write, 2 = read after a write (both can
+    #                            be set; drives keys_get replay parity)
     scount: jnp.ndarray        # (N,) i32
     sbase: jnp.ndarray         # (N,) i32 (0 = zero K-array base, else sym)
     calldata: jnp.ndarray      # (N, C) u8
@@ -219,6 +222,7 @@ def init_sym_lanes(
         svals=z((n, storage_slots, bv256.NLIMBS), jnp.uint32),
         sval_sid=z((n, storage_slots), jnp.int32),
         s_written=z((n, storage_slots), jnp.int32),
+        s_read=z((n, storage_slots), jnp.int32),
         scount=z((n,), jnp.int32),
         sbase=z((n,), jnp.int32),
         calldata=z((n, calldata_bytes), jnp.uint8),
@@ -474,7 +478,10 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         | overflow
         | oog
         | dlog_full
-        | (is_exp & any_sym & ~exp_pure)
+        # impure EXP parks even with all-concrete operands: the host
+        # path pins Power(base,exp) == const in the constraints, and a
+        # device-executed EXP would silently drop that axiom
+        | (is_exp & ~exp_pure)
         # memory
         | (mem_ops & sym_a)                  # symbolic offset
         | (is_mstore8 & sym_b)               # symbolic byte value
@@ -489,7 +496,10 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         | cd_oob
         # control flow
         | (is_jump & (sym_a | ~dest_ok))
-        | (is_jumpi & ~sym_b & jumpi_taken_conc & ~dest_ok)
+        # concrete-true condition: a symbolic dest must park (its
+        # placeholder limbs would decode to a garbage-but-maybe-valid
+        # JUMPDEST and silently take an unconstrained jump)
+        | (is_jumpi & ~sym_b & jumpi_taken_conc & (sym_a | ~dest_ok))
         | (is_jumpi & sym_b & (sym_a | ~dest_ok))
     )
 
@@ -662,19 +672,30 @@ def sym_step(code: CompiledCode, st: SymLaneState,
             st.s_written, do_write, pos_c,
             jnp.maximum(new_written, _gather_flat(st.s_written, pos_c)),
         )
+        # the interpreter's Storage.__getitem__ records *every* read in
+        # keys_get; track whether this slot was read before/after its
+        # first write so materialize can replay the reads
+        do_sread = ok & is_sload
+        prior_written = _gather_flat(st.s_written, pos_c)
+        rd_bit = jnp.where(prior_written > 0, 2, 1)
+        sr = _scatter_flat(
+            st.s_read, do_sread, pos_c,
+            rd_bit | _gather_flat(st.s_read, pos_c),
+        )
         sc = jnp.where(do_write & ~s_found, st.scount + 1, st.scount)
-        return sk, sv, ssd, swr, sc, sload_v
+        return sk, sv, ssd, swr, sr, sc, sload_v
 
     # provisional id for this step's deferred record (used by storage
     # cache insertion and the result sid select)
     prov_id = -(lanes * d_recs + jnp.clip(st.dlog_count, 0, d_recs - 1)
                 + 1)
 
-    skeys2, svals2, sval_sid2, s_written2, scount2, sload_r = lax.cond(
+    (skeys2, svals2, sval_sid2, s_written2, s_read2, scount2,
+     sload_r) = lax.cond(
         jnp.any(ok & (is_sload | is_sstore)),
         _storage_block,
         lambda: (st.skeys, st.svals, st.sval_sid, st.s_written,
-                 st.scount, zero_w),
+                 st.s_read, st.scount, zero_w),
     )
 
     # ---- calldata execution (concrete path) -------------------------------
@@ -840,6 +861,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         svals=svals2,
         sval_sid=sval_sid2,
         s_written=s_written2,
+        s_read=s_read2,
         scount=scount2,
         calldata=st.calldata,
         min_gas=min_gas,

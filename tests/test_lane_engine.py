@@ -117,6 +117,8 @@ def state_sig(tag, gs):
     storage = gs.environment.active_account.storage
     keys = {k.raw.tid if hasattr(k, "raw") else k
             for k in storage.keys_set}
+    keys_get = {k.raw.tid if hasattr(k, "raw") else k
+                for k in storage.keys_get}
     reads = []
     for k in sorted({k.value for k in storage.keys_set
                      if k.value is not None}
@@ -125,7 +127,8 @@ def state_sig(tag, gs):
         reads.append(
             (k, storage[symbol_factory.BitVecVal(k, 256)].raw.tid))
     return (
-        tag, ms.pc, stack, consts, mem, frozenset(keys), tuple(reads),
+        tag, ms.pc, stack, consts, mem, frozenset(keys),
+        frozenset(keys_get), tuple(reads),
         len(ms.memory), ms.min_gas_used, ms.max_gas_used, ms.depth,
     )
 
@@ -229,6 +232,22 @@ def test_nested_forks_four_paths():
     differential(bytes(c), expect_paths=4)
 
 
+def test_symbolic_dest_jumpi_concrete_true_cond():
+    # JUMPI with a concrete-true condition but a *symbolic* destination:
+    # the device must park (the placeholder limbs of the symbolic dest
+    # decode to 0, which is a valid JUMPDEST here — pre-fix the lane
+    # silently jumped to it with no path condition); the host
+    # interpreter skips the jump (get_concrete_int TypeError -> pc+1).
+    code = bytes(
+        asm("JUMPDEST")                      # pc 0: the trap dest
+        + push(1, 1)                         # concrete-true condition
+        + push(0, 1) + asm("CALLDATALOAD")   # symbolic destination
+        + asm("JUMPI")
+        + push(7, 1) + push(0, 1) + asm("SSTORE", "STOP")
+    )
+    differential(code, expect_paths=1)
+
+
 def test_symbolic_memory_roundtrip():
     # MSTORE a symbolic word, MLOAD it back, store it
     code = bytes(
@@ -265,6 +284,24 @@ def test_storage_symbolic_value_and_miss():
         + push(9, 1) + asm("SLOAD")
         + asm("ADD")
         + push(0, 1) + asm("SSTORE")
+        + asm("STOP")
+    )
+    differential(code, expect_paths=1)
+
+
+def test_storage_read_write_orders():
+    # keys_get parity for every read/write interleaving on a slot:
+    # slot 0 read-then-written, slot 1 written-then-read, slot 2
+    # read-only, slot 3 write-only — the interpreter records reads in
+    # keys_get in all cases, so the materialized states must too.
+    code = bytes(
+        push(0, 1) + asm("SLOAD")                    # read slot 0
+        + push(1, 1) + asm("ADD")
+        + push(0, 1) + asm("SSTORE")                 # write slot 0
+        + push(7, 1) + push(1, 1) + asm("SSTORE")    # write slot 1
+        + push(1, 1) + asm("SLOAD")                  # read slot 1 back
+        + push(2, 1) + asm("SLOAD") + asm("ADD")     # read slot 2
+        + push(3, 1) + asm("SSTORE")                 # write slot 3
         + asm("STOP")
     )
     differential(code, expect_paths=1)
@@ -326,6 +363,19 @@ def test_div_and_exp_paths():
         + push(32, 1) + asm("CALLDATALOAD")
         + asm("DIV")
         + push(0, 1) + asm("SSTORE") + asm("STOP")
+    )
+    differential(code, expect_paths=1)
+
+
+def test_concrete_impure_exp_parks_for_power_axiom():
+    # 3**5 with all-concrete operands: the host pushes the constant but
+    # ALSO pins Power(3,5) == 243 in the constraints; the device must
+    # park rather than execute it constraint-free.
+    code = bytes(
+        push(5, 1) + push(3, 1) + asm("EXP")         # 243 + Power axiom
+        + push(0, 1) + asm("SSTORE")
+        + push(0, 1) + asm("CALLDATALOAD")           # keep a symbolic tail
+        + push(1, 1) + asm("SSTORE") + asm("STOP")
     )
     differential(code, expect_paths=1)
 
