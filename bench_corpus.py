@@ -28,7 +28,7 @@ CREATION_FIXTURES = {
 }
 
 
-def analyze_one(path: Path, timeout: int):
+def analyze_one(path: Path, timeout: int, tpu_lanes: int = 0):
     from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
     from mythril_tpu.orchestration.mythril_disassembler import (
         MythrilDisassembler,
@@ -46,6 +46,7 @@ def analyze_one(path: Path, timeout: int):
         parallel_solving=False, call_depth_limit=3,
         disable_dependency_pruning=False, custom_modules_directory="",
         solver_log=None, transaction_sequences=None,
+        tpu_lanes=tpu_lanes,
     )
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
@@ -68,7 +69,13 @@ def main():
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--timeout", type=int, default=60)
-    timeout = parser.parse_args().timeout
+    parser.add_argument(
+        "--tpu-lanes", type=int, default=0,
+        help="lane-engine width (0 = host interpreter); corpus mode "
+        "amortizes device init/trace/compile-cache over all contracts",
+    )
+    cli = parser.parse_args()
+    timeout = cli.timeout
     fixtures = sorted(INPUTS.glob("*.sol.o"))
     if not fixtures:
         print(f"no *.sol.o fixtures under {INPUTS}", file=sys.stderr)
@@ -77,7 +84,7 @@ def main():
     t0 = time.perf_counter()
     for path in fixtures:
         try:
-            r = analyze_one(path, timeout)
+            r = analyze_one(path, timeout, cli.tpu_lanes)
         except Exception as e:  # noqa: BLE001 - keep sweeping
             r = {"contract": path.name, "error": type(e).__name__}
         results.append(r)

@@ -64,6 +64,41 @@ class OverUnderflowStateAnnotation(StateAnnotation):
         return new_annotation
 
 
+def arithmetic_overflow_constraint(opname: str, op0: BitVec,
+                                   op1: BitVec):
+    """(constraint, operator-name) the pre-hooks attach for one
+    arithmetic op, or (None, name) when the op can't overflow. Shared
+    between the interpreter hooks below and the lane engine's drain-time
+    adapter (lane_adapters.IntegerAdapter), so device-executed paths
+    annotate identically."""
+    if opname == "ADD":
+        return Not(BVAddNoOverflow(op0, op1, False)), "addition"
+    if opname == "SUB":
+        return Not(BVSubNoUnderflow(op0, op1, False)), "subtraction"
+    if opname == "MUL":
+        return Not(BVMulNoOverflow(op0, op1, False)), "multiplication"
+    if opname == "EXP":
+        if (op1.symbolic is False and op1.value == 0) or (
+            op0.symbolic is False and op0.value < 2
+        ):
+            return None, "exponentiation"
+        if op0.symbolic and op1.symbolic:
+            constraint = And(
+                op1 > symbol_factory.BitVecVal(256, 256),
+                op0 > symbol_factory.BitVecVal(1, 256),
+            )
+        elif op0.symbolic:
+            constraint = op0 >= symbol_factory.BitVecVal(
+                2 ** ceil(256 / op1.value), 256
+            )
+        else:
+            constraint = op1 >= symbol_factory.BitVecVal(
+                ceil(256 / log2(op0.value)), 256
+            )
+        return constraint, "exponentiation"
+    raise ValueError(opname)
+
+
 class IntegerArithmetics(DetectionModule):
     """Searches for integer over- and underflows."""
 
@@ -120,43 +155,26 @@ class IntegerArithmetics(DetectionModule):
         )
 
     def _handle_add(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVAddNoOverflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "addition", c))
+        self._annotate_arith(state, "ADD")
 
     def _handle_mul(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVMulNoOverflow(op0, op1, False))
-        op0.annotate(
-            OverUnderflowAnnotation(state, "multiplication", c)
-        )
+        self._annotate_arith(state, "MUL")
 
     def _handle_sub(self, state):
-        op0, op1 = self._get_args(state)
-        c = Not(BVSubNoUnderflow(op0, op1, False))
-        op0.annotate(OverUnderflowAnnotation(state, "subtraction", c))
+        self._annotate_arith(state, "SUB")
 
     def _handle_exp(self, state):
+        self._annotate_arith(state, "EXP")
+
+    def _annotate_arith(self, state, opname):
         op0, op1 = self._get_args(state)
-        if (op1.symbolic is False and op1.value == 0) or (
-            op0.symbolic is False and op0.value < 2
-        ):
+        constraint, operator = arithmetic_overflow_constraint(
+            opname, op0, op1
+        )
+        if constraint is None:
             return
-        if op0.symbolic and op1.symbolic:
-            constraint = And(
-                op1 > symbol_factory.BitVecVal(256, 256),
-                op0 > symbol_factory.BitVecVal(1, 256),
-            )
-        elif op0.symbolic:
-            constraint = op0 >= symbol_factory.BitVecVal(
-                2 ** ceil(256 / op1.value), 256
-            )
-        else:
-            constraint = op1 >= symbol_factory.BitVecVal(
-                ceil(256 / log2(op0.value)), 256
-            )
         op0.annotate(
-            OverUnderflowAnnotation(state, "exponentiation", constraint)
+            OverUnderflowAnnotation(state, operator, constraint)
         )
 
     @staticmethod

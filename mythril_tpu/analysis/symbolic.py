@@ -142,20 +142,28 @@ class SymExecWrapper:
         # the dependency pruner's per-basic-block maps are built from
         # SLOAD/SSTORE/JUMP hooks the lane engine would bypass; it is a
         # prune-only optimization, so it is dropped when the lane engine
-        # will actually run — but kept when a selected module hooks
-        # JUMPI, which makes the lane sweep bail out anyway
+        # will actually run — and kept when a selected module pins JUMPI
+        # to the host (no lane adapter), which idles the sweep
         # (svm._lane_engine_sweep) and pruning is all the help we get
-        lane_engine_active = bool(args.tpu_lanes)
+        lane_engine_active = bool(args.tpu_lanes) \
+            and not args.use_issue_annotations
         if lane_engine_active and run_analysis_modules:
+            # mirror of svm._lane_engine_sweep's hook gate: a module
+            # hooking JUMPI idles the sweep (every branch parks) UNLESS
+            # its lane adapter serves that hook at drain time
+            from .module.lane_adapters import get_adapter
+
             cb_modules = ModuleLoader().get_detection_modules(
                 EntryPoint.CALLBACK, modules
             )
-            if any(
-                "JUMPI" in (m.pre_hooks or [])
-                or "JUMPI" in (m.post_hooks or [])
-                for m in cb_modules
-            ):
-                lane_engine_active = False
+            for m in cb_modules:
+                hooks = set(m.pre_hooks or []) | set(m.post_hooks or [])
+                if "JUMPI" not in hooks:
+                    continue
+                ad = get_adapter(m)
+                if ad is None or "JUMPI" not in ad.lifted_hooks:
+                    lane_engine_active = False
+                    break
         if lane_engine_active:
             # probe availability with an actual op (device enumeration
             # can succeed while execution is broken): if the sweep would
@@ -174,6 +182,12 @@ class SymExecWrapper:
                 lane_engine_active = False
         if not disable_dependency_pruning and not lane_engine_active:
             plugin_loader.load(DependencyPrunerBuilder())
+        elif lane_engine_active:
+            # the loader is a process-wide singleton: a pruner loaded by
+            # an earlier host-path analysis in this process would hook
+            # JUMPI and idle the lane sweep — unload it for this run
+            plugin_loader.laser_plugin_builders.pop(
+                DependencyPrunerBuilder.name, None)
         plugin_loader.instrument_virtual_machine(self.laser, None)
 
         world_state = WorldState()

@@ -26,9 +26,10 @@ Provisional (negative) sids minted on device encode (lane, record-slot)
 and are rewritten to table ids at each drain.
 """
 
+import functools
 import logging
 from collections import deque
-from copy import deepcopy
+from copy import copy, deepcopy
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -65,6 +66,11 @@ class ObjectTable:
         return len(self._objs) - 1
 
     def __getitem__(self, sid: int):
+        if sid <= 0:
+            # a negative sid here means a provisional id leaked through
+            # a drain unresolved (e.g. a plane slot remapped to -1) —
+            # fail loudly instead of returning an unrelated object
+            raise IndexError(f"unresolved/invalid sid {sid}")
         return self._objs[sid]
 
     def __len__(self):
@@ -73,30 +79,345 @@ class ObjectTable:
 
 class LaneCtx:
     """Host context of one device lane: the pristine entry state it was
-    seeded from plus the path conditions accumulated through drains."""
+    seeded from, the (step-stamped) path conditions accumulated through
+    drains, and per-adapter sink-taint promotions."""
 
     __slots__ = ("template", "conds", "addr2idx", "storage_seed_raw",
-                 "calldata", "gas0_min", "gas0_max")
+                 "calldata", "gas0_min", "gas0_max", "promos")
 
     def __init__(self, template, addr2idx, storage_seed_raw, calldata,
                  gas0_min, gas0_max):
         self.template = template
-        self.conds: List[Bool] = []
+        # [(global step, Bool)] — the step stamp lets drain-time sites
+        # reconstruct the constraint prefix at any earlier record
+        self.conds: List[tuple] = []
         self.addr2idx = addr2idx
         self.storage_seed_raw = storage_seed_raw
         self.calldata = calldata
         self.gas0_min = gas0_min
         self.gas0_max = gas0_max
+        # adapter-id -> [(step, annotation)] (lane_adapters promotions)
+        self.promos: Dict[int, List[tuple]] = {}
 
     def clone(self) -> "LaneCtx":
         c = LaneCtx(self.template, self.addr2idx, self.storage_seed_raw,
                     self.calldata, self.gas0_min, self.gas0_max)
         c.conds = list(self.conds)
+        c.promos = {k: list(v) for k, v in self.promos.items()}
         return c
+
+
+class _DrainSite:
+    """A reconstructed pre-hook site: enough of the GlobalState at a
+    device-executed instruction (pc, constraint prefix, gas interval,
+    active function, relevant stack tail) for an unmodified detection
+    module to run against. Built lazily — most sites are never
+    materialized."""
+
+    __slots__ = ("engine", "ctx", "step", "byte_pc", "fentry", "gmin",
+                 "gmax", "stack_tail", "_prefix")
+
+    def __init__(self, engine, ctx, step, byte_pc, fentry, gmin=None,
+                 gmax=None, stack_tail=(), prefix=None):
+        self.engine = engine
+        self.ctx = ctx
+        self.step = step
+        self.byte_pc = byte_pc
+        self.fentry = fentry
+        self.gmin = gmin
+        self.gmax = gmax
+        self.stack_tail = stack_tail
+        self._prefix = prefix  # explicit snapshot, or None -> by step
+
+    def _conds(self):
+        if self._prefix is not None:
+            return self._prefix
+        return [c for (s, c) in self.ctx.conds if s < self.step]
+
+    def build_state(self) -> GlobalState:
+        # copy(), not deepcopy(): the same sharing level the
+        # interpreter's own per-instruction StateTransition copy uses —
+        # accounts/storage fork independently, terms/code are shared
+        gs = copy(self.ctx.template)
+        for c in self._conds():
+            gs.world_state.constraints.append(c)
+        ms = gs.mstate
+        a2i = self.ctx.addr2idx
+        ms.pc = int(a2i[min(self.byte_pc, a2i.shape[0] - 1)])
+        if self.gmin is not None:
+            ms.min_gas_used = self.ctx.gas0_min + int(self.gmin)
+            ms.max_gas_used = self.ctx.gas0_max + int(self.gmax)
+        fentry = self.fentry
+        if fentry >= 0 and fentry in self.engine._func_names:
+            gs.environment.active_function_name = \
+                self.engine._func_names[fentry]
+        for v in self.stack_tail:
+            ms.stack.append(v)
+        return gs
+
+    def lazy_ostate(self):
+        return _LazyOState(self)
+
+    def fire_module_pre_hook(self, module):
+        """Run the module's hook entry point against this site — the
+        function name makes module_helpers.is_prehook() report True,
+        exactly as under svm._execute_pre_hook."""
+        module.execute(self.build_state())
+
+
+class _LazyOState:
+    """Materialize-on-first-touch proxy for annotation-captured states
+    (the integer module stores one per arithmetic op; almost none are
+    ever promoted to a sink, so the deepcopy is deferred)."""
+
+    __slots__ = ("_site", "_gs")
+
+    def __init__(self, site):
+        self._site = site
+        self._gs = None
+
+    def __getattr__(self, name):
+        if self._gs is None:
+            self._gs = self._site.build_state()
+        return getattr(self._gs, name)
+
+
+#: stats of the most recent completed explore() in this process — lets
+#: callers/tests assert the device path genuinely ran (a fallback to the
+#: host interpreter would make lane-vs-host comparisons vacuous)
+LAST_RUN_STATS: Optional[dict] = None
 
 
 def _bv_val(v: int) -> BitVec:
     return symbol_factory.BitVecVal(v, 256)
+
+
+def _pow2_bucket(k: int, cap: int) -> int:
+    """Smallest power of two >= k (capped): variable-length host<->device
+    batches are padded to bucketed shapes so each bucket jit-compiles
+    once instead of once per length."""
+    from ..ops.intervals import _next_pow2
+
+    return min(_next_pow2(k), cap)
+
+
+# ---- fused per-window device calls (one dispatch each; every extra
+# dispatch is a full round trip on a tunneled backend) -----------------------
+
+import jax  # noqa: E402  (this module is only imported on the lane path)
+import jax.numpy as jnp  # noqa: E402
+
+
+N_MISC = 4  # dlog_count, pclog_count, status, steps
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _window_prologue(st: SymLaneState, idx, i32p, u32p, u8p, fs,
+                     fcount) -> SymLaneState:
+    """Per-window device prologue in ONE dispatch: reset + seed the
+    rows in idx (padded entries hold n -> dropped) from three packed
+    host arrays, and refresh the free-slot stack."""
+    k = idx.shape[0]
+    n_env = st.env.shape[1]
+
+    def zero(plane):
+        return plane.at[idx].set(0, mode="drop")
+
+    # i32 pack: [sbase, cd_size, cd_sym, cd_size_sid, env_sid…]
+    sbase, cd_size, cd_sym, cd_size_sid = (
+        i32p[:, 0], i32p[:, 1], i32p[:, 2], i32p[:, 3])
+    env_sid = i32p[:, 4:4 + n_env]
+    # u32 pack: [gas_limit, env limbs…]
+    gas_limit = u32p[:, 0]
+    env = u32p[:, 1:].reshape(k, n_env, bv256.NLIMBS)
+
+    return st._replace(
+        pc=zero(st.pc),
+        sp=zero(st.sp),
+        depth=zero(st.depth),
+        ssid=zero(st.ssid),
+        memory=zero(st.memory),
+        mkind=zero(st.mkind),
+        msize=zero(st.msize),
+        mlog_count=zero(st.mlog_count),
+        sval_sid=zero(st.sval_sid),
+        s_written=zero(st.s_written),
+        s_read=zero(st.s_read),
+        scount=zero(st.scount),
+        skeys=zero(st.skeys),
+        svals=zero(st.svals),
+        min_gas=zero(st.min_gas),
+        max_gas=zero(st.max_gas),
+        steps=zero(st.steps),
+        dlog_count=zero(st.dlog_count),
+        pclog_count=zero(st.pclog_count),
+        fentry=st.fentry.at[idx].set(-1, mode="drop"),
+        last_jump=st.last_jump.at[idx].set(-1, mode="drop"),
+        status=st.status.at[idx].set(Status.RUNNING, mode="drop"),
+        sbase=st.sbase.at[idx].set(sbase, mode="drop"),
+        calldata=st.calldata.at[idx].set(u8p, mode="drop"),
+        cd_size=st.cd_size.at[idx].set(cd_size, mode="drop"),
+        cd_sym=st.cd_sym.at[idx].set(cd_sym, mode="drop"),
+        cd_size_sid=st.cd_size_sid.at[idx].set(cd_size_sid,
+                                               mode="drop"),
+        env=st.env.at[idx].set(env, mode="drop"),
+        env_sid=st.env_sid.at[idx].set(env_sid, mode="drop"),
+        gas_limit=st.gas_limit.at[idx].set(gas_limit, mode="drop"),
+        free_slots=fs,
+        free_count=fcount,
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnums=(2, 3, 4, 5))
+def _retire_rows(st: SymLaneState, ridx, dstack: int, dmem: int,
+                 dmlog: int, dslot: int):
+    """Gather the retired lanes' rows (3 packed arrays, column-clipped
+    to the busiest retired lane — planes are mostly padding) AND mark
+    them free, one dispatch. Padded ridx entries hold n: the status
+    write drops them and the gather clamps (host ignores those rows)."""
+    rc = jnp.clip(ridx, 0, st.pc.shape[0] - 1)
+    k = ridx.shape[0]
+
+    def flat(x):
+        return x.reshape(k, -1)
+
+    i32 = jnp.concatenate([
+        st.pc[rc, None], st.sp[rc, None], st.depth[rc, None],
+        st.fentry[rc, None], st.last_jump[rc, None],
+        st.msize[rc, None], st.mlog_count[rc, None],
+        st.scount[rc, None],
+        st.min_gas[rc, None].astype(jnp.int32),  # < 2^31: exact
+        st.max_gas[rc, None].astype(jnp.int32),
+        st.mlog_off[rc, :dmlog], st.mlog_len[rc, :dmlog],
+        st.mlog_sid[rc, :dmlog],
+        st.ssid[rc, :dstack],
+        st.sval_sid[rc, :dslot], st.s_written[rc, :dslot],
+        st.s_read[rc, :dslot],
+    ], axis=1)
+    u32 = jnp.concatenate([
+        flat(st.stack[rc, :dstack]),
+        flat(st.skeys[rc, :dslot]), flat(st.svals[rc, :dslot]),
+    ], axis=1)
+    u8 = jnp.concatenate(
+        [st.memory[rc, :dmem], st.mkind[rc, :dmem]], axis=1)
+    st = st._replace(status=st.status.at[ridx].set(DEAD, mode="drop"))
+    return st, (i32, u32, u8)
+
+
+def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
+    """Host-side inverse of _retire_rows' packing."""
+    i32, u32, u8 = [np.asarray(x) for x in packed]
+    k = i32.shape[0]
+    out = {}
+    off = 0
+    for name in ("pc", "sp", "depth", "fentry", "last_jump", "msize",
+                 "mlog_count", "scount", "min_gas", "max_gas"):
+        out[name] = i32[:, off]
+        off += 1
+    for name, w in (("mlog_off", dmlog), ("mlog_len", dmlog),
+                    ("mlog_sid", dmlog), ("ssid", dstack),
+                    ("sval_sid", dslot), ("s_written", dslot),
+                    ("s_read", dslot)):
+        out[name] = i32[:, off:off + w]
+        off += w
+    off = 0
+    for name, w, shp in (
+        ("stack", dstack * bv256.NLIMBS, (dstack, bv256.NLIMBS)),
+        ("skeys", dslot * bv256.NLIMBS, (dslot, bv256.NLIMBS)),
+        ("svals", dslot * bv256.NLIMBS, (dslot, bv256.NLIMBS)),
+    ):
+        out[name] = u32[:, off:off + w].reshape((k,) + shp)
+        off += w
+    out["memory"] = u8[:, :dmem]
+    out["mkind"] = u8[:, dmem:]
+    return out
+
+
+@jax.jit
+def _window_counts(st: SymLaneState):
+    """Tiny first pull: per-lane counters + scalars (drives the sized
+    log/retire gathers)."""
+    misc = jnp.stack(
+        [st.dlog_count, st.pclog_count, st.status, st.steps,
+         st.sp, st.scount, st.mlog_count, st.msize], axis=1)
+    scal = jnp.stack([st.flog_count, st.free_count])
+    return misc, scal
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _gather_logs_rows(st: SymLaneState, act, dmax: int, pmax: int):
+    """Log rows of the active lanes only, column-clipped to the busiest
+    lane's record count (log planes are mostly empty padding)."""
+    from jax import lax
+
+    rc = jnp.clip(act, 0, st.pc.shape[0] - 1)
+    k = act.shape[0]
+    dlog = jnp.concatenate([
+        st.dlog_op[rc, :dmax, None], st.dlog_pc[rc, :dmax, None],
+        st.dlog_step[rc, :dmax, None], st.dlog_fentry[rc, :dmax, None],
+        st.dlog_sid[rc, :dmax],
+        lax.bitcast_convert_type(st.dlog_val[rc, :dmax], jnp.int32)
+        .reshape(k, dmax, 3 * bv256.NLIMBS),
+    ], axis=2)
+    pclog = jnp.concatenate([
+        st.pclog_sid[rc, :pmax, None], st.pclog_neg[rc, :pmax, None],
+        st.pclog_pc[rc, :pmax, None], st.pclog_step[rc, :pmax, None],
+        st.pclog_fentry[rc, :pmax, None],
+        lax.bitcast_convert_type(st.pclog_gmin[rc, :pmax],
+                                 jnp.int32)[..., None],
+        lax.bitcast_convert_type(st.pclog_gmax[rc, :pmax],
+                                 jnp.int32)[..., None],
+    ], axis=2)
+    flog = jnp.stack(
+        [st.flog_parent, st.flog_child, st.flog_step], axis=1)
+    return dlog, pclog, flog
+
+
+def _unpack_logs(pulled):
+    """Host views over the packed log gather, keyed like per-field
+    arrays (row index = position in the act list)."""
+    dlog, pclog, flog = [np.asarray(x) for x in pulled]
+    k, dmax = dlog.shape[0], dlog.shape[1]
+    h = {
+        "dlog_op": dlog[:, :, 0], "dlog_pc": dlog[:, :, 1],
+        "dlog_step": dlog[:, :, 2], "dlog_fentry": dlog[:, :, 3],
+        "dlog_sid": dlog[:, :, 4:7],
+        "dlog_val": np.ascontiguousarray(dlog[:, :, 7:])
+        .view(np.uint32).reshape(k, dmax, 3, bv256.NLIMBS),
+        "pclog_sid": pclog[:, :, 0], "pclog_neg": pclog[:, :, 1],
+        "pclog_pc": pclog[:, :, 2], "pclog_step": pclog[:, :, 3],
+        "pclog_fentry": pclog[:, :, 4],
+        "pclog_gmin": np.ascontiguousarray(pclog[:, :, 5])
+        .view(np.uint32).reshape(k, -1),
+        "pclog_gmax": np.ascontiguousarray(pclog[:, :, 6])
+        .view(np.uint32).reshape(k, -1),
+        "flog_parent": flog[:, 0], "flog_child": flog[:, 1],
+        "flog_step": flog[:, 2],
+    }
+    return h
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _drain_reset(st: SymLaneState, prov_arr) -> SymLaneState:
+    """Remap provisional sids to resolved object ids (device-side — the
+    sid planes never leave the device) and reset the per-window logs."""
+    d_recs = st.dlog_op.shape[1]
+
+    def remap(plane):
+        negm = plane < 0
+        idx = jnp.where(negm, -plane - 1, 0)
+        mapped = prov_arr[idx // d_recs, idx % d_recs]
+        return jnp.where(negm, mapped, plane)
+
+    return st._replace(
+        ssid=remap(st.ssid),
+        sval_sid=remap(st.sval_sid),
+        mlog_sid=remap(st.mlog_sid),
+        dlog_count=jnp.zeros_like(st.dlog_count),
+        pclog_count=jnp.zeros_like(st.pclog_count),
+        flog_count=jnp.zeros_like(st.flog_count),
+    )
 
 
 def _limbs_int(limbs) -> int:
@@ -163,20 +484,40 @@ class LaneEngine:
 
     def __init__(self, n_lanes: int = 256, window: int = 48,
                  step_budget: int = 8192, blocked_ops=None,
-                 **lane_kwargs):
+                 adapters=None, **lane_kwargs):
         self.n_lanes = n_lanes
         self.window = window
         self.step_budget = step_budget
         self.lane_kwargs = lane_kwargs
         # opcodes with registered detector hooks must park so the hooks
-        # fire host-side; remove them from the device-executable set
+        # fire host-side; remove them from the device-executable set.
+        # Modules with a lane adapter (analysis/module/lane_adapters.py)
+        # are instead served at drain time and their hooks stay lifted.
         import jax.numpy as jnp
 
-        table = np.asarray(symstep.SYM_EXECUTABLE).copy()
+        from ..support.devices import enable_compile_cache
+
+        enable_compile_cache()
+
+        table = symstep.SYM_EXECUTABLE.copy()
         for name in blocked_ops or ():
             if name in _OPB:
                 table[_OPB[name]] = False
         self.exec_table = jnp.asarray(table)
+        self.adapters = list(adapters or ())
+        taint = np.zeros(256, bool)
+        for ad in self.adapters:
+            for name in ad.taint_ops:
+                if name in _OPB:
+                    taint[_OPB[name]] = True
+        self.taint_table = jnp.asarray(taint)
+        # arithmetic records get their pc in the memo key when an
+        # adapter annotates them (the annotation site is per-pc)
+        self._annot_ops = {
+            op for ad in self.adapters
+            for op in ("ADD", "SUB", "MUL", "EXP")
+            if op in ad.taint_ops
+        }
         self.objects = ObjectTable()
         self._func_names: Dict[int, str] = {}
         # repeated CALLDATALOADs at the same offset across lanes resolve
@@ -184,6 +525,7 @@ class LaneEngine:
         # terms per word)
         self._cdl_cache: Dict[Tuple[int, int], BitVec] = {}
         self._record_memo: Dict[tuple, int] = {}
+        self._fired_sites: set = set()
         self.stats = {
             "seeded": 0, "forks": 0, "records": 0, "parked": 0,
             "dead": 0, "device_steps": 0, "windows": 0,
@@ -199,11 +541,14 @@ class LaneEngine:
         ms = gs.mstate
 
         def entry(val):
+            # (concrete value, None) | (None, symbolic wrapper) — the
+            # object slot must be None for concrete values: downstream
+            # consumers test `obj is not None`
             if isinstance(val, int):
-                return val, 0
+                return val, None
             if isinstance(val, BitVec) and val.value is not None:
-                return val.value, 0
-            return None, self.objects.add(val)
+                return val.value, None
+            return None, val  # symbolic: sid assigned after adapters
 
         out = {}
         out["ADDRESS"] = entry(env.address)
@@ -260,12 +605,28 @@ class LaneEngine:
                       gas0_min, gas0_max)
 
         envw = self._env_words(gs)
+        if self.adapters:
+            # taint seeding: annotating the env source terms once per
+            # seed is host-equivalent — the interpreter's post-hooks
+            # annotate the same shared wrapper the handlers push.
+            # Adapters may also REPLACE an entry (e.g. ORIGIN gets its
+            # own wrapper so the shared sender object isn't tainted)
+            env_objects = {
+                name: obj for name, (val, obj) in envw.items()
+                if obj is not None
+            }
+            for ad in self.adapters:
+                ad.seed_env(env_objects, gs)
+            envw = {
+                name: (val, env_objects.get(name, obj))
+                for name, (val, obj) in envw.items()
+            }
         env_vals = np.zeros((symstep.N_ENV, bv256.NLIMBS), np.uint32)
         env_sids = np.zeros(symstep.N_ENV, np.int32)
         for name, slot in symstep.ENV_SLOTS.items():
-            val, sid = envw[name]
-            if sid:
-                env_sids[slot] = sid
+            val, obj = envw[name]
+            if obj is not None:
+                env_sids[slot] = self.objects.add(obj)
             else:
                 env_vals[slot] = bv256.int_to_limbs(val or 0)
 
@@ -293,59 +654,41 @@ class LaneEngine:
         )
 
     def seed_all(self, st: SymLaneState, entries,
-                 ctxs: List[Optional[LaneCtx]]) -> SymLaneState:
-        """Batched device write of [(lane, GlobalState)] seeds: one
-        scatter per field instead of ~25 eager updates per lane."""
-        import jax.numpy as jnp
-
-        if not entries:
-            return st
+                 ctxs: List[Optional[LaneCtx]], free) -> SymLaneState:
+        """One fused device prologue per window: reset + seed the new
+        entries (3 packed host arrays) and refresh the free-slot stack.
+        Called every window even with no entries (the free list changed
+        if lanes retired)."""
         cap = st.calldata.shape[1]
+        n = self.n_lanes
+        n_env = symstep.N_ENV
         lanes, specs = [], []
         for lane, gs in entries:
             ctx, spec = self._seed_spec(gs, cap)
             ctxs[lane] = ctx
             lanes.append(lane)
             specs.append(spec)
-        idx = jnp.asarray(np.asarray(lanes, np.int32))
-
-        def col(name, dtype):
-            return jnp.asarray(
-                np.asarray([s[name] for s in specs], dtype))
-
-        st = st._replace(
-            pc=st.pc.at[idx].set(0),
-            sp=st.sp.at[idx].set(0),
-            depth=st.depth.at[idx].set(0),
-            ssid=st.ssid.at[idx].set(0),
-            memory=st.memory.at[idx].set(0),
-            mkind=st.mkind.at[idx].set(0),
-            msize=st.msize.at[idx].set(0),
-            mlog_count=st.mlog_count.at[idx].set(0),
-            sval_sid=st.sval_sid.at[idx].set(0),
-            s_written=st.s_written.at[idx].set(0),
-            s_read=st.s_read.at[idx].set(0),
-            scount=st.scount.at[idx].set(0),
-            sbase=st.sbase.at[idx].set(col("sbase", np.int32)),
-            calldata=st.calldata.at[idx].set(
-                col("calldata", np.uint8)),
-            cd_size=st.cd_size.at[idx].set(col("cd_size", np.int32)),
-            cd_sym=st.cd_sym.at[idx].set(col("cd_sym", np.int32)),
-            cd_size_sid=st.cd_size_sid.at[idx].set(
-                col("cd_size_sid", np.int32)),
-            env=st.env.at[idx].set(col("env", np.uint32)),
-            env_sid=st.env_sid.at[idx].set(col("env_sid", np.int32)),
-            min_gas=st.min_gas.at[idx].set(0),
-            max_gas=st.max_gas.at[idx].set(0),
-            gas_limit=st.gas_limit.at[idx].set(
-                col("gas_limit", np.uint32)),
-            fentry=st.fentry.at[idx].set(-1),
-            status=st.status.at[idx].set(Status.RUNNING),
-            steps=st.steps.at[idx].set(0),
-            dlog_count=st.dlog_count.at[idx].set(0),
-            pclog_count=st.pclog_count.at[idx].set(0),
-            skeys=st.skeys.at[idx].set(0),
-            svals=st.svals.at[idx].set(0),
+        k = _pow2_bucket(max(len(lanes), 1), n)
+        idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
+        idx[: len(lanes)] = lanes
+        i32p = np.zeros((k, 4 + n_env), np.int32)
+        u32p = np.zeros((k, 1 + n_env * bv256.NLIMBS), np.uint32)
+        u8p = np.zeros((k, cap), np.uint8)
+        for i, s in enumerate(specs):
+            i32p[i, 0] = s["sbase"]
+            i32p[i, 1] = s["cd_size"]
+            i32p[i, 2] = s["cd_sym"]
+            i32p[i, 3] = s["cd_size_sid"]
+            i32p[i, 4:] = s["env_sid"]
+            u32p[i, 0] = s["gas_limit"]
+            u32p[i, 1:] = s["env"].reshape(-1)
+            u8p[i] = s["calldata"]
+        fs = np.zeros(n, np.int32)
+        fs[: len(free)] = free
+        st = _window_prologue(
+            st, jnp.asarray(idx), jnp.asarray(i32p), jnp.asarray(u32p),
+            jnp.asarray(u8p), jnp.asarray(fs),
+            jnp.asarray(np.int32(len(free))),
         )
         self.stats["seeded"] += len(entries)
         return st
@@ -396,6 +739,29 @@ class LaneEngine:
                                       alu.to_bitvec(args[0]))
         raise AssertionError(f"unresolvable deferred op {opname}")
 
+    def _jumpi_site_work(self, ctx, lane, cond, step, byte_pc, fentry,
+                         gmin, gmax):
+        """Drain-time detector work for one path-condition record:
+        per-lane sink promotions, plus site-firing modules deduped
+        across the sibling lanes sharing the record (the interpreter
+        fires its pre-hook once per JUMPI execution; issue identity is
+        per (site, condition, path prefix))."""
+        prefix = [c for (_, c) in ctx.conds]
+        site = _DrainSite(self, ctx, step, byte_pc, fentry, gmin, gmax,
+                          stack_tail=(cond, _bv_val(0)), prefix=prefix)
+        for ad in self.adapters:
+            anns = ad.on_jumpi(cond, site)
+            if anns:
+                ctx.promos.setdefault(id(ad), []).extend(
+                    (step, a) for a in anns)
+        key = (step, byte_pc, cond.raw.tid,
+               tuple(c.raw.tid for c in prefix))
+        if key in self._fired_sites:
+            return
+        self._fired_sites.add(key)
+        for ad in self.adapters:
+            ad.on_jumpi_site(cond, site)
+
     def drain(self, st: SymLaneState,
               ctxs: List[Optional[LaneCtx]]) -> Tuple[SymLaneState,
                                                       List[int]]:
@@ -406,37 +772,39 @@ class LaneEngine:
         import jax.numpy as jnp
 
         d_recs = st.dlog_op.shape[1]
+        p_recs = st.pclog_sid.shape[1]
         n = st.pc.shape[0]
 
-        # two-phase transfer: counts first (tiny), then only the rows of
-        # lanes that actually logged anything — the logs dominate bytes
-        # and ride a (possibly tunneled) device link
-        counts_h = jax.device_get({
-            "dlog_count": st.dlog_count,
-            "pclog_count": st.pclog_count,
-            "flog_count": st.flog_count,
-            "status": st.status,
-            "steps": st.steps,
-            "free_count": st.free_count,
-        })
+        # two-phase sized transfer: tiny counters first, then only the
+        # active lanes' log rows clipped to the busiest lane's record
+        # count — log planes are mostly empty padding, and both the
+        # per-pull latency AND the byte volume matter on a tunneled link
+        misc, scal = [np.asarray(x) for x in
+                      jax.device_get(_window_counts(st))]
+        counts_h = {
+            "dlog_count": misc[:, 0], "pclog_count": misc[:, 1],
+            "status": misc[:, 2], "steps": misc[:, 3],
+            "sp": misc[:, 4], "scount": misc[:, 5],
+            "mlog_count": misc[:, 6], "msize": misc[:, 7],
+            "flog_count": int(scal[0]), "free_count": int(scal[1]),
+        }
         self.last_counts = counts_h  # explore reads these (one pull)
+        nf = counts_h["flog_count"]
         act = np.nonzero(
             (counts_h["dlog_count"] > 0) | (counts_h["pclog_count"] > 0)
         )[0].astype(np.int32)
-        nf = int(counts_h["flog_count"])
-        act_j = jnp.asarray(act)
-        h = jax.device_get({
-            "dlog_op": st.dlog_op[act_j],
-            "dlog_sid": st.dlog_sid[act_j],
-            "dlog_val": st.dlog_val[act_j],
-            "dlog_step": st.dlog_step[act_j],
-            "pclog_sid": st.pclog_sid[act_j],
-            "pclog_neg": st.pclog_neg[act_j],
-            "flog_parent": st.flog_parent[:nf],
-            "flog_child": st.flog_child[:nf],
-            "ssid": st.ssid, "sval_sid": st.sval_sid,
-            "mlog_sid": st.mlog_sid,
-        })
+        if not len(act) and not nf:
+            return _drain_reset(st, jnp.asarray(np.full(
+                (n, d_recs), np.iinfo(np.int32).min, np.int32))), []
+        ka = _pow2_bucket(max(len(act), 1), n)
+        act_pad = np.zeros(ka, np.int32)
+        act_pad[: len(act)] = act
+        dmax = _pow2_bucket(
+            max(int(counts_h["dlog_count"].max()), 1), d_recs)
+        pmax = _pow2_bucket(
+            max(int(counts_h["pclog_count"].max()), 1), p_recs)
+        h = _unpack_logs(jax.device_get(
+            _gather_logs_rows(st, jnp.asarray(act_pad), dmax, pmax)))
         row_of = {int(lane): i for i, lane in enumerate(act)}
         h["dlog_count"] = counts_h["dlog_count"]
         h["pclog_count"] = counts_h["pclog_count"]
@@ -449,20 +817,39 @@ class LaneEngine:
             ctxs[child] = ctxs[parent].clone()
         self.stats["forks"] += nf
 
-        # 2. deferred records in (step, lane, slot) order
+        # 2. deferred records in (step, lane, slot) order. SSTORE rows
+        # are taint-sink records (no term to build); arithmetic rows
+        # fire adapter annotations BEFORE the result term is built so
+        # annotation union propagates exactly as in the interpreter.
         recs = []
         counts = h["dlog_count"]
         for lane in np.nonzero(counts > 0)[0]:
-            row = row_of[int(lane)]
+            lane = int(lane)
+            row = row_of[lane]
             for k in range(int(counts[lane])):
-                recs.append((int(h["dlog_step"][row, k]), int(lane), k))
+                recs.append((int(h["dlog_step"][row, k]), lane, k))
         recs.sort()
         prov: Dict[Tuple[int, int], int] = {}
-        for _, lane, k in recs:
+        # lane -> [(step, adapter-id, annotation)] minted this window
+        # from dlog sink records (inherited across forks below)
+        window_promos: Dict[int, list] = {}
+        for step, lane, k in recs:
             row = row_of[lane]
             opname = _OPN[int(h["dlog_op"][row, k])]
             sids = h["dlog_sid"][row, k]
             vals = h["dlog_val"][row, k]
+            if opname == "SSTORE":
+                value = self._resolve_arg(int(sids[1]), vals[1], prov,
+                                          d_recs)
+                site = _DrainSite(
+                    self, ctxs[lane], step,
+                    int(h["dlog_pc"][row, k]),
+                    int(h["dlog_fentry"][row, k]))
+                for ad in self.adapters:
+                    for ann in ad.on_sstore(alu.to_bitvec(value), site):
+                        window_promos.setdefault(lane, []).append(
+                            (step, id(ad), ann))
+                continue
             # dedup identical records across lanes: forked paths
             # recompute the same terms in lockstep, and one resolution
             # (one shared wrapper — host parity: sibling states share
@@ -481,6 +868,11 @@ class LaneEngine:
             # SLOAD/CALLDATALOAD resolve against per-seed context
             if opname in ("SLOAD", "CALLDATALOAD"):
                 key_parts.append(("ctx", id(ctxs[lane].template)))
+            # annotated arithmetic is per-site: two executions at
+            # different pcs must annotate separately (the interpreter
+            # captures a distinct ostate per execution)
+            if opname in self._annot_ops:
+                key_parts.append(("pc", int(h["dlog_pc"][row, k])))
             key = tuple(key_parts)
             oid = self._record_memo.get(key)
             if oid is None:
@@ -489,6 +881,16 @@ class LaneEngine:
                                       d_recs)
                     for j in range(3)
                 ]
+                if opname in self._annot_ops:
+                    site = _DrainSite(
+                        self, ctxs[lane], step,
+                        int(h["dlog_pc"][row, k]),
+                        int(h["dlog_fentry"][row, k]))
+                    cargs = [alu.to_bitvec(x) if not isinstance(x, int)
+                             else _bv_val(x) for x in args[:2]]
+                    for ad in self.adapters:
+                        ad.pre_resolve(opname, cargs, site)
+                    args[:2] = cargs
                 obj = self._resolve_record(ctxs[lane], opname, args)
                 # sids model stack slots: apply MachineStack.append's
                 # coercion (state/machine_state.py — Bool/int pushes
@@ -502,12 +904,14 @@ class LaneEngine:
             prov[(lane, k)] = oid
         self.stats["records"] += len(recs)
 
-        # 3. path conditions -> ctx.conds (jumpi_ handler semantics)
+        # 3. path conditions -> ctx.conds (jumpi_ handler semantics),
+        # with drain-time JUMPI detector work per fork site
         dead: List[int] = []
         pcounts = h["pclog_count"]
         for lane in np.nonzero(pcounts > 0)[0]:
             lane = int(lane)
             row = row_of[lane]
+            ctx = ctxs[lane]
             lane_dead = False
             for j in range(int(pcounts[lane])):
                 sid = int(h["pclog_sid"][row, j])
@@ -518,6 +922,15 @@ class LaneEngine:
                     idx = -sid - 1
                     cond = self.objects[prov[(idx // d_recs,
                                               idx % d_recs)]]
+                if self.adapters:
+                    self._jumpi_site_work(
+                        ctx, lane, cond,
+                        step=int(h["pclog_step"][row, j]),
+                        byte_pc=int(h["pclog_pc"][row, j]),
+                        fentry=int(h["pclog_fentry"][row, j]),
+                        gmin=int(h["pclog_gmin"][row, j]),
+                        gmax=int(h["pclog_gmax"][row, j]),
+                    )
                 if isinstance(cond, Bool):
                     chosen = simplify(Not(cond)) if neg \
                         else simplify(cond)
@@ -526,39 +939,38 @@ class LaneEngine:
                 if chosen.is_false:
                     lane_dead = True
                     break
-                ctxs[lane].conds.append(chosen)
+                ctx.conds.append((int(h["pclog_step"][row, j]), chosen))
             if lane_dead:
                 dead.append(lane)
         self.stats["dead"] += len(dead)
 
-        # 4. provisional sid rewrite
-        prov_arr = np.full((n, d_recs), -1, np.int32)
+        # 3b. fork-inherit this window's dlog-sourced promotions (the
+        # child's deferred log is reset at fork, so records minted by
+        # the parent before the fork must flow down); flog is in step
+        # order, so multi-level descent resolves in one pass
+        if window_promos or nf:
+            for i in range(nf):
+                parent = int(h["flog_parent"][i])
+                child = int(h["flog_child"][i])
+                fstep = int(h["flog_step"][i])
+                inherited = [p for p in window_promos.get(parent, ())
+                             if p[0] <= fstep]
+                if inherited:
+                    window_promos.setdefault(child, []).extend(inherited)
+            for lane, plist in window_promos.items():
+                promos = ctxs[lane].promos
+                for step, ad_id, ann in plist:
+                    promos.setdefault(ad_id, []).append((step, ann))
+
+        # 4. provisional sid rewrite (device-side: the sid planes never
+        # leave the device) + per-window log reset, one dispatch
+        # unresolved slots map to int32 min (NOT -1, which is the
+        # legitimate provisional encoding of lane 0 slot 0) so a leaked
+        # sid fails loudly downstream instead of aliasing a real record
+        prov_arr = np.full((n, d_recs), np.iinfo(np.int32).min, np.int32)
         for (lane, k), oid in prov.items():
             prov_arr[lane, k] = oid
-
-        def remap(plane):
-            negm = plane < 0
-            if not negm.any():
-                return plane, False
-            idx = np.where(negm, -plane - 1, 0)
-            mapped = prov_arr[idx // d_recs, idx % d_recs]
-            assert not (negm & (mapped < 0)).any(), \
-                "unresolved provisional sid"
-            return np.where(negm, mapped, plane), True
-
-        ssid2, ch1 = remap(h["ssid"])
-        sval2, ch2 = remap(h["sval_sid"])
-        mlog2, ch3 = remap(h["mlog_sid"])
-
-        zero_i = jnp.zeros_like(st.dlog_count)
-        st = st._replace(
-            ssid=jnp.asarray(ssid2) if ch1 else st.ssid,
-            sval_sid=jnp.asarray(sval2) if ch2 else st.sval_sid,
-            mlog_sid=jnp.asarray(mlog2) if ch3 else st.mlog_sid,
-            dlog_count=zero_i,
-            pclog_count=jnp.zeros_like(st.pclog_count),
-            flog_count=jnp.zeros_like(st.flog_count),
-        )
+        st = _drain_reset(st, jnp.asarray(prov_arr))
         return st, dead
 
     # -- materialization -----------------------------------------------------
@@ -567,10 +979,12 @@ class LaneEngine:
                     ctx: LaneCtx) -> GlobalState:
         """Rebuild a host GlobalState for a parked lane. `st_host` is a
         device_get of the SymLaneState."""
-        gs = deepcopy(ctx.template)
+        # copy(), not deepcopy() — interpreter-fork sharing semantics;
+        # per-lane Account/Storage instances keep mutations independent
+        gs = copy(ctx.template)
         ms = gs.mstate
 
-        for cond in ctx.conds:
+        for _, cond in ctx.conds:
             gs.world_state.constraints.append(cond)
 
         byte_pc = int(st_host["pc"][lane])
@@ -659,6 +1073,14 @@ class LaneEngine:
             if not list(gs.get_annotations(MutationAnnotation)):
                 gs.annotate(MutationAnnotation())
 
+        # adapter state transfer (sink promotions, last-jump tracking)
+        if self.adapters:
+            last_jump = int(st_host["last_jump"][lane]) \
+                if "last_jump" in st_host else -1
+            for ad in self.adapters:
+                plist = ctx.promos.get(id(ad), ())
+                ad.attach(gs, [a for (_, a) in plist], last_jump)
+
         self.stats["parked"] += 1
         return gs
 
@@ -687,17 +1109,17 @@ class LaneEngine:
         while True:
             entries = []
             while queue and free:
-                entries.append((free.pop(), queue.popleft()))
-            st = self.seed_all(st, entries, ctxs)
-            fs = np.zeros(self.n_lanes, np.int32)
-            fs[: len(free)] = free
-            st = st._replace(
-                free_slots=jnp.asarray(fs),
-                free_count=jnp.asarray(len(free), jnp.int32),
-            )
+                gs = queue.popleft()
+                if self.adapters and not all(
+                    ad.seed_ok(gs) for ad in self.adapters
+                ):
+                    results.append(gs)  # host handles this entry
+                    continue
+                entries.append((free.pop(), gs))
+            st = self.seed_all(st, entries, ctxs, free)
             n_free_written = len(free)
             st = symstep.sym_run_jit(cc, st, self.window,
-                                     self.exec_table)
+                                     self.exec_table, self.taint_table)
             self.stats["windows"] += 1
             st, dead = self.drain(st, ctxs)
             # drain pulled status/steps/free_count in its counts batch
@@ -719,27 +1141,31 @@ class LaneEngine:
             retire = sorted(set(np.nonzero(parked)[0].tolist())
                             | set(dead))
             if retire:
-                # transfer only the retired lanes' rows (device-side
-                # gather): the memory/stack planes dominate bytes
-                ridx = jnp.asarray(np.asarray(retire, np.int32))
-                st_host = jax.device_get({
-                    "pc": st.pc[ridx], "sp": st.sp[ridx],
-                    "depth": st.depth[ridx], "fentry": st.fentry[ridx],
-                    "stack": st.stack[ridx], "ssid": st.ssid[ridx],
-                    "memory": st.memory[ridx], "mkind": st.mkind[ridx],
-                    "msize": st.msize[ridx],
-                    "mlog_off": st.mlog_off[ridx],
-                    "mlog_len": st.mlog_len[ridx],
-                    "mlog_sid": st.mlog_sid[ridx],
-                    "mlog_count": st.mlog_count[ridx],
-                    "skeys": st.skeys[ridx], "svals": st.svals[ridx],
-                    "sval_sid": st.sval_sid[ridx],
-                    "s_written": st.s_written[ridx],
-                    "s_read": st.s_read[ridx],
-                    "scount": st.scount[ridx],
-                    "min_gas": st.min_gas[ridx],
-                    "max_gas": st.max_gas[ridx],
-                })
+                # transfer only the retired lanes' rows and mark them
+                # free in the same fused call (the memory/stack planes
+                # dominate bytes; the dispatch count dominates latency)
+                c = self.last_counts
+                rsel = np.asarray(retire, np.int32)
+                lk = self.lane_kwargs
+                dstack = _pow2_bucket(
+                    max(int(c["sp"][rsel].max()), 1),
+                    lk.get("stack_depth", 64))
+                dmem = _pow2_bucket(
+                    max(int(c["msize"][rsel].max()), 1),
+                    lk.get("memory_bytes", 4096))
+                dmlog = _pow2_bucket(
+                    max(int(c["mlog_count"][rsel].max()), 1),
+                    lk.get("mem_records", 64))
+                dslot = _pow2_bucket(
+                    max(int(c["scount"][rsel].max()), 1),
+                    lk.get("storage_slots", 64))
+                kr = _pow2_bucket(len(retire), self.n_lanes)
+                ridx = np.full(kr, self.n_lanes, np.int32)
+                ridx[: len(retire)] = retire
+                st, rows = _retire_rows(st, jnp.asarray(ridx),
+                                        dstack, dmem, dmlog, dslot)
+                st_host = _unpack_rows(jax.device_get(rows),
+                                       dstack, dmem, dmlog, dslot)
                 dead_set = set(dead)
                 for row, lane in enumerate(retire):
                     self.stats["device_steps"] += int(steps[lane])
@@ -748,10 +1174,11 @@ class LaneEngine:
                             self.materialize(st_host, row, ctxs[lane]))
                     ctxs[lane] = None
                     free.append(lane)
-                st = st._replace(status=st.status.at[ridx].set(DEAD))
                 status[np.asarray(retire, np.int32)] = DEAD
 
             running = int(np.sum(status == Status.RUNNING))
             if not running and not queue:
                 break
+        global LAST_RUN_STATS
+        LAST_RUN_STATS = dict(self.stats)
         return results

@@ -312,9 +312,13 @@ class LaserEVM:
         if not eligible:
             return
         # every opcode with a registered hook must park device-side so
-        # the hook fires on the host; universal per-instruction hooks
-        # disable the sweep outright — except telemetry-only hooks
-        # (marked lane_engine_safe, e.g. the instruction profiler's)
+        # the hook fires on the host — unless the hook's module has a
+        # lane adapter (analysis/module/lane_adapters.py) that lifts it:
+        # those hooks are served at drain time instead, which keeps the
+        # device forking/executing on the hot opcodes the taint modules
+        # hook (JUMPI, arithmetic, SSTORE). Universal per-instruction
+        # hooks disable the sweep outright — except telemetry-only ones
+        # (marked lane_engine_safe, e.g. the instruction profiler's).
         def _essential(hooks):
             return [h for h in hooks
                     if not getattr(h, "lane_engine_safe", False)]
@@ -323,15 +327,30 @@ class LaserEVM:
                 or any(_essential(h)
                        for h in self.instr_post_hook.values()):
             return
-        blocked = {op for op, hooks in self.pre_hooks.items()
-                   if _essential(hooks)}
-        blocked |= {op for op, hooks in self.post_hooks.items()
-                    if _essential(hooks)}
+        try:
+            from ..analysis.module.lane_adapters import get_adapter
+        except Exception:  # pragma: no cover
+            get_adapter = lambda m: None  # noqa: E731
+        # drain-fired issues flow through module.issues; when the
+        # issue-annotation mode diverts them onto states, lifted hooks
+        # would lose their issues — keep everything parked instead
+        can_lift = not args.use_issue_annotations
+        adapters: List[object] = []
+        blocked = set()
+        for hook_dict in (self.pre_hooks, self.post_hooks):
+            for opname, hooks in hook_dict.items():
+                for h in _essential(hooks):
+                    ad = get_adapter(getattr(h, "__self__", None)) \
+                        if can_lift else None
+                    if ad is not None and opname in ad.lifted_hooks:
+                        if ad not in adapters:
+                            adapters.append(ad)
+                    else:
+                        blocked.add(opname)
         if "JUMPI" in blocked:
-            # a detector hooks every branch: the device cannot fork, so
-            # batching buys nothing — stay on the host path (the drain-
-            # side hook adapter lifting this is future work)
-            log.info("lane engine idle: a loaded module hooks JUMPI")
+            # a hook without an adapter pins every branch to the host:
+            # the device cannot fork, so batching buys nothing
+            log.info("lane engine idle: JUMPI hooked without an adapter")
             return
         groups: Dict[bytes, List[GlobalState]] = {}
         for code, gs in eligible:
@@ -341,7 +360,8 @@ class LaserEVM:
         for code, states in groups.items():
             try:
                 engine = LaneEngine(n_lanes=args.tpu_lanes,
-                                    blocked_ops=blocked)
+                                    blocked_ops=blocked,
+                                    adapters=adapters)
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(

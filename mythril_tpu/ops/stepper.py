@@ -157,14 +157,11 @@ def _build_tables():
         b = 0x8F + i
         supported[b] = True
 
-    return (
-        jnp.asarray(npop),
-        jnp.asarray(npush),
-        jnp.asarray(static_gas),
-        jnp.asarray(supported),
-        jnp.asarray(env_slot),
-        jnp.asarray(result_class),
-    )
+    # numpy masters: device-resident constant tables would be pulled
+    # back D2H during every MLIR lowering (~seconds on a tunneled
+    # backend); numpy constants embed for free. Traced code wraps them
+    # with jnp.asarray at the use site.
+    return (npop, npush, static_gas, supported, env_slot, result_class)
 
 
 (
@@ -380,15 +377,15 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
     op = code.opcode[pc_c]
     op = jnp.where(running, op, _OP["STOP"]).astype(jnp.int32)
 
-    npop = NPOP_TABLE[op]
-    npush = NPUSH_TABLE[op]
+    npop = jnp.asarray(NPOP_TABLE)[op]
+    npush = jnp.asarray(NPUSH_TABLE)[op]
     is_dup = (op >= 0x80) & (op <= 0x8F)
     is_swap = (op >= 0x90) & (op <= 0x9F)
     dup_n = jnp.where(is_dup, op - 0x7F, 1)
     swap_n = jnp.where(is_swap, op - 0x8F, 1)
     eff_pop = jnp.where(is_dup, dup_n, jnp.where(is_swap, swap_n + 1, npop))
 
-    unsupported = ~SUPPORTED_TABLE[op]
+    unsupported = ~jnp.asarray(SUPPORTED_TABLE)[op]
     underflow = st.sp < eff_pop
     overflow = (st.sp - npop + npush) > depth
 
@@ -597,7 +594,7 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
     )
 
     # ---- env words / misc push-only results ------------------------------
-    env_idx = ENV_TABLE[op]
+    env_idx = jnp.asarray(ENV_TABLE)[op]
     env_r = _onehot_gather(st.env, jnp.clip(env_idx, 0, N_ENV - 1))
     pc_r = bv256.from_u32(st.pc.astype(jnp.uint32))
     gas_r = bv256.from_u32(st.gas_limit - st.gas_used)
@@ -619,7 +616,7 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
     )
     assert len(cases) == len(RESULT_CLASSES)
     which = jnp.broadcast_to(
-        RESULT_CLASS_TABLE[op][:, None], (n, bv256.NLIMBS)
+        jnp.asarray(RESULT_CLASS_TABLE)[op][:, None], (n, bv256.NLIMBS)
     )
     result = lax.select_n(which, *cases)
 
@@ -690,7 +687,7 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
 
     # ---- status resolution ----------------------------------------------
     status = st.status
-    oog = (st.gas_used + GAS_TABLE[op]) > st.gas_limit
+    oog = (st.gas_used + jnp.asarray(GAS_TABLE)[op]) > st.gas_limit
 
     def mark(cond, code_):
         nonlocal status
@@ -706,7 +703,7 @@ def step(code: CompiledCode, st: LaneState) -> LaneState:
     advanced = status == Status.RUNNING  # still running after this op
 
     gas_used = jnp.where(
-        running & ~parked, st.gas_used + GAS_TABLE[op], st.gas_used
+        running & ~parked, st.gas_used + jnp.asarray(GAS_TABLE)[op], st.gas_used
     )
 
     return LaneState(

@@ -47,6 +47,7 @@ the quadratic memory-expansion fee of machine_state.calculate_memory_gas),
 so materialized states carry exactly the gas the interpreter would have.
 """
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -122,11 +123,12 @@ def _build_sym_tables():
     for b in range(0x60, 0xA0):  # PUSH1-32, DUP1-16, SWAP1-16
         executable[b] = True
 
-    return jnp.asarray(gas_min), jnp.asarray(gas_max), \
-        jnp.asarray(executable), jnp.asarray(deferrable)
+    return gas_min, gas_max, executable, deferrable
 
 
-GAS_MIN_TABLE, GAS_MAX_TABLE, SYM_EXECUTABLE, DEFERRABLE = \
+# numpy masters (host-side consumers must NOT pull the jnp versions
+# back — a device_get through a tunneled chip costs seconds)
+(GAS_MIN_TABLE, GAS_MAX_TABLE, SYM_EXECUTABLE, DEFERRABLE) = \
     _build_sym_tables()
 
 
@@ -141,6 +143,9 @@ class SymLaneState(NamedTuple):
     depth: jnp.ndarray         # (N,) i32 — JUMPI fork depth (host parity)
     fentry: jnp.ndarray        # (N,) i32 — last function-entry jump dest
     #                            (-1 = none; svm._new_node_state parity)
+    last_jump: jnp.ndarray     # (N,) i32 — byte pc of the last executed
+    #                            JUMP (-1 = none; feeds the exceptions
+    #                            module's LastJumpAnnotation at drain)
     stack: jnp.ndarray         # (N, D, 8) u32
     ssid: jnp.ndarray          # (N, D) i32
     memory: jnp.ndarray        # (N, M) u8
@@ -173,11 +178,17 @@ class SymLaneState(NamedTuple):
     dlog_op: jnp.ndarray       # (N, R) i32
     dlog_pc: jnp.ndarray       # (N, R) i32
     dlog_step: jnp.ndarray     # (N, R) i32
+    dlog_fentry: jnp.ndarray   # (N, R) i32 — fentry at record time
     dlog_sid: jnp.ndarray      # (N, R, 3) i32
     dlog_val: jnp.ndarray      # (N, R, 3, 8) u32
     dlog_count: jnp.ndarray    # (N,) i32
     pclog_sid: jnp.ndarray     # (N, P) i32
     pclog_neg: jnp.ndarray     # (N, P) i32 (1 = negated side)
+    pclog_pc: jnp.ndarray      # (N, P) i32 — byte pc of the JUMPI
+    pclog_step: jnp.ndarray    # (N, P) i32 — global step of the fork
+    pclog_gmin: jnp.ndarray    # (N, P) u32 — gas interval at the JUMPI
+    pclog_gmax: jnp.ndarray    # (N, P) u32   (pre-execution, hook parity)
+    pclog_fentry: jnp.ndarray  # (N, P) i32 — fentry at the JUMPI
     pclog_count: jnp.ndarray   # (N,) i32
     flog_parent: jnp.ndarray   # (F,) i32
     flog_child: jnp.ndarray    # (F,) i32
@@ -191,17 +202,15 @@ class SymLaneState(NamedTuple):
 MAX_FORKS_PER_STEP = 64
 
 
-def init_sym_lanes(
-    n_lanes: int,
-    stack_depth: int = 64,
-    memory_bytes: int = 4096,
-    mem_records: int = 64,
-    storage_slots: int = 64,
-    calldata_bytes: int = 512,
-    dlog_records: int = 64,
-    pc_records: int = 64,
-    gas_limit: int = 8_000_000,
+@functools.partial(jax.jit, static_argnums=tuple(range(9)))
+def _init_sym_lanes_dev(
+    n_lanes, stack_depth, memory_bytes, mem_records, storage_slots,
+    calldata_bytes, dlog_records, pc_records, gas_limit,
 ) -> SymLaneState:
+    # one jitted (and persistently cached) executable builds the whole
+    # zero state on device: per-field jnp.zeros would compile ~40 tiny
+    # fill kernels, and numpy+device_put pays ~40 H2D transfers — both
+    # are seconds over a tunneled backend
     z = jnp.zeros
     n = n_lanes
     return SymLaneState(
@@ -209,6 +218,7 @@ def init_sym_lanes(
         sp=z((n,), jnp.int32),
         depth=z((n,), jnp.int32),
         fentry=jnp.full((n,), -1, jnp.int32),
+        last_jump=jnp.full((n,), -1, jnp.int32),
         stack=z((n, stack_depth, bv256.NLIMBS), jnp.uint32),
         ssid=z((n, stack_depth), jnp.int32),
         memory=z((n, memory_bytes), jnp.uint8),
@@ -239,19 +249,42 @@ def init_sym_lanes(
         dlog_op=z((n, dlog_records), jnp.int32),
         dlog_pc=z((n, dlog_records), jnp.int32),
         dlog_step=z((n, dlog_records), jnp.int32),
+        dlog_fentry=z((n, dlog_records), jnp.int32),
         dlog_sid=z((n, dlog_records, 3), jnp.int32),
         dlog_val=z((n, dlog_records, 3, bv256.NLIMBS), jnp.uint32),
         dlog_count=z((n,), jnp.int32),
         pclog_sid=z((n, pc_records), jnp.int32),
         pclog_neg=z((n, pc_records), jnp.int32),
+        pclog_pc=z((n, pc_records), jnp.int32),
+        pclog_step=z((n, pc_records), jnp.int32),
+        pclog_gmin=z((n, pc_records), jnp.uint32),
+        pclog_gmax=z((n, pc_records), jnp.uint32),
+        pclog_fentry=z((n, pc_records), jnp.int32),
         pclog_count=z((n,), jnp.int32),
         flog_parent=z((n,), jnp.int32),
         flog_child=z((n,), jnp.int32),
         flog_step=z((n,), jnp.int32),
         flog_count=jnp.zeros((), jnp.int32),
         free_slots=jnp.arange(n - 1, -1, -1, dtype=jnp.int32),
-        free_count=jnp.asarray(n, jnp.int32),
+        free_count=jnp.full((), n, jnp.int32),
         step_no=jnp.zeros((), jnp.int32),
+    )
+
+
+def init_sym_lanes(
+    n_lanes: int,
+    stack_depth: int = 64,
+    memory_bytes: int = 4096,
+    mem_records: int = 64,
+    storage_slots: int = 64,
+    calldata_bytes: int = 512,
+    dlog_records: int = 64,
+    pc_records: int = 64,
+    gas_limit: int = 8_000_000,
+) -> SymLaneState:
+    return _init_sym_lanes_dev(
+        n_lanes, stack_depth, memory_bytes, mem_records, storage_slots,
+        calldata_bytes, dlog_records, pc_records, gas_limit,
     )
 
 
@@ -284,16 +317,56 @@ def _mem_fee(old_bytes, new_bytes):
     return new_fee - old_fee
 
 
+def _nbits(x):
+    """(…, 8) u32 limbs -> number of significant bits (0 for zero)."""
+    bl = 32 - lax.clz(x).astype(jnp.int32)
+    pos = bl + 32 * jnp.arange(bv256.NLIMBS, dtype=jnp.int32)
+    return jnp.max(jnp.where(x != 0, pos, 0), axis=-1)
+
+
+def _build_mstore_pattern_masks():
+    """The user-assertions module fires on concrete MSTOREs whose hex
+    rendering starts with the 60-digit 0xcafe… scribble pattern
+    (analysis/module/modules/user_assertions.py). A value of nd hex
+    digits (no leading zeros) matches iff value >> 4*(nd-60) equals the
+    240-bit pattern, nd in [60, 64] — precompute (mask, expect) pairs."""
+    pat = int("cafe" * 15, 16)  # 240 bits
+    masks, expects = [], []
+    for s in range(0, 20, 4):
+        mask = ((1 << 256) - 1) ^ ((1 << s) - 1)
+        masks.append(bv256.int_to_limbs(mask))
+        expects.append(bv256.int_to_limbs((pat << s) & ((1 << 256) - 1)))
+    return np.stack(masks), np.stack(expects)
+
+
+MSTORE_PAT_MASK, MSTORE_PAT_EXPECT = _build_mstore_pattern_masks()
+
+
 def sym_step(code: CompiledCode, st: SymLaneState,
-             exec_table: jnp.ndarray = None) -> SymLaneState:
+             exec_table: jnp.ndarray = None,
+             taint_table: jnp.ndarray = None) -> SymLaneState:
     """Advance every running lane by one instruction (symbolic mode).
 
     exec_table: optional (256,) bool — the set of opcodes the device may
     execute this run. The bridge passes SYM_EXECUTABLE minus every
     opcode with a registered detector pre/post hook, so hooked
-    instructions always park and fire their hooks host-side."""
+    instructions always park and fire their hooks host-side.
+
+    taint_table: optional (256,) bool — opcodes needing drain-side
+    detector support (the lane adapters that LIFT a hook from the parked
+    set, analysis/module/lane_adapters.py). Per-op meaning:
+    ADD/SUB/MUL/EXP — emit a deferred record when all-concrete operands
+    actually wrap (the integer module annotates concrete overflows too);
+    SSTORE — emit a sink record when the stored value is symbolic (taint
+    promotion parity); MSTORE — park when a concrete value matches the
+    user-assertions 0xcafe… pattern."""
     if exec_table is None:
         exec_table = SYM_EXECUTABLE
+    if taint_table is None:
+        taint_table = np.zeros(256, bool)
+    # numpy tables embed as free constants; traced args pass through
+    exec_table = jnp.asarray(exec_table)
+    taint_table = jnp.asarray(taint_table)
     n, depth_cap, _ = st.stack.shape
     mem_bytes = st.memory.shape[1]
     mem_recs = st.mlog_off.shape[1]
@@ -308,8 +381,8 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # idle lanes execute JUMPDEST (a supported no-op) to stay masked out
     op = jnp.where(running, op, _OP["JUMPDEST"]).astype(jnp.int32)
 
-    npop = NPOP_TABLE[op]
-    npush = NPUSH_TABLE[op]
+    npop = jnp.asarray(NPOP_TABLE)[op]
+    npush = jnp.asarray(NPUSH_TABLE)[op]
     is_dup = (op >= 0x80) & (op <= 0x8F)
     is_swap = (op >= 0x90) & (op <= 0x9F)
     dup_n = jnp.where(is_dup, op - 0x7F, 1)
@@ -377,6 +450,72 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         lax.population_count(a.astype(jnp.uint32)), axis=-1
     )
     exp_pure = ~sym_a & (a_popcount <= 1)
+
+    # ---- drain-side taint support (lane adapters) -------------------------
+    # all-concrete arithmetic that actually wraps must still reach the
+    # host: the integer module annotates concrete overflows too (its
+    # constraint folds true). Such ops emit a deferred record like their
+    # symbolic siblings; non-wrapping concrete ops stay record-free
+    # (their constraint folds false and the host filters them anyway).
+    is_add = op == _OP["ADD"]
+    is_sub = op == _OP["SUB"]
+    is_mul = op == _OP["MUL"]
+    taint_op = taint_table[op]
+    wrap_cand = (
+        running & ~any_sym & taint_op
+        & (is_add | is_sub | is_mul | (is_exp & exp_pure))
+    )
+
+    def _wrap_flags():
+        w_add = is_add & bv256.ult(bv256.add(a, b), a)
+        w_sub = is_sub & bv256.ult(a, b)
+        nb_a = _nbits(a)
+        nb_b = _nbits(b)
+        w_mul_cand = is_mul & (nb_a + nb_b >= 257)
+
+        def _mul_exact():
+            _, hi = bv256.mul_full(a, b)
+            return ~bv256.is_zero(hi)
+
+        w_mul = w_mul_cand & lax.cond(
+            jnp.any(wrap_cand & w_mul_cand), _mul_exact, lambda: zero_b
+        )
+        # pure EXP base 2^m (m>=1): wraps iff exp >= ceil(256/m), i.e.
+        # m*exp >= 256 — the integer module's own concrete bound
+        m_exp = nb_a - 1
+        e_hi = jnp.any(b[..., 1:] != 0, axis=-1)
+        e0 = jnp.minimum(b[..., 0], jnp.uint32(1 << 20)).astype(jnp.int32)
+        w_exp = (
+            is_exp & exp_pure & (a_popcount == 1) & (m_exp >= 1)
+            & (e_hi | (m_exp * e0 >= 256))
+        )
+        return w_add | w_sub | w_mul | w_exp
+
+    wrap_rec = wrap_cand & lax.cond(
+        jnp.any(wrap_cand), _wrap_flags, lambda: zero_b
+    )
+
+    # SSTORE of a symbolic value leaves a sink record so taint promotion
+    # (integer module JUMPI/SSTORE sinks) sees every store, not just the
+    # final storage contents
+    sink_want = is_sstore & taint_op & (sid_b != 0)
+
+    # concrete MSTORE matching the user-assertions 0xcafe… pattern parks
+    # (the module fires its issue at the MSTORE site host-side)
+    mstore_pat_cand = running & is_mstore & ~sym_b & taint_op
+
+    def _mstore_pat():
+        nd = (_nbits(b) + 3) // 4
+        idx = jnp.clip(nd - 60, 0, 4)
+        hit = jnp.all(
+            (b & jnp.asarray(MSTORE_PAT_MASK)[idx])
+            == jnp.asarray(MSTORE_PAT_EXPECT)[idx], axis=-1
+        )
+        return (nd >= 60) & hit
+
+    mstore_pat_park = mstore_pat_cand & lax.cond(
+        jnp.any(mstore_pat_cand), _mstore_pat, lambda: zero_b
+    )
 
     # ---- memory overlay decisions (MLOAD) — gated: the kind-plane
     # gather and overlay scans read O(N*32 + N*MR) every evaluation ------
@@ -460,14 +599,14 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     )
 
     # ---- deferral decision ------------------------------------------------
-    defer = DEFERRABLE[op] & any_sym
+    defer = jnp.asarray(DEFERRABLE)[op] & any_sym
     defer = defer & ~(is_exp & ~exp_pure)  # impure EXP parks below
-    defer = defer | cdl_defer | sload_miss_sym
-    dlog_full = defer & (st.dlog_count >= d_recs)
+    defer = defer | cdl_defer | sload_miss_sym | wrap_rec
+    dlog_full = (defer | sink_want) & (st.dlog_count >= d_recs)
 
     # ---- gas --------------------------------------------------------------
-    gmin = GAS_MIN_TABLE[op] + mem_fee
-    gmax = GAS_MAX_TABLE[op] + mem_fee
+    gmin = jnp.asarray(GAS_MIN_TABLE)[op] + mem_fee
+    gmax = jnp.asarray(GAS_MAX_TABLE)[op] + mem_fee
     min_gas_after = st.min_gas + gmin
     oog = min_gas_after > st.gas_limit
 
@@ -494,6 +633,8 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         # calldata
         | (is_cdl & ~cd_symbolic & sym_a)
         | cd_oob
+        # user-assertions scribble pattern (hook fires host-side)
+        | mstore_pat_park
         # control flow
         | (is_jump & (sym_a | ~dest_ok))
         # concrete-true condition: a symbolic dest must park (its
@@ -513,11 +654,18 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     flog_room = st.flog_parent.shape[0] - st.flog_count
     navail = jnp.minimum(navail, flog_room)
     fork_can = fork_req & (forder < navail)
-    fork_nocap = (fork_req & ~fork_can) | pclog_full_f
+    # over the per-step fork budget but within the free pool: STALL the
+    # lane (retry the JUMPI next step) instead of parking it — parking
+    # would push whole subtrees back to the host whenever one step
+    # wants more than MAX_FORKS_PER_STEP forks
+    fork_stall = fork_req & ~fork_can & (forder < st.free_count)
+    fork_nocap = (fork_req & ~fork_can & ~fork_stall) | pclog_full_f
 
     park = park0 | fork_nocap
-    ok = running & ~park
+    ok = running & ~park & ~fork_stall
     defer = defer & ok
+    sink_rec = sink_want & ok
+    logrec = defer | sink_rec
     fork_can = fork_can & ok
 
     # ---- concrete ALU families (gated; only lanes with all-concrete
@@ -714,7 +862,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     )
 
     # ---- env / misc results ----------------------------------------------
-    env_idx = ENV_TABLE[op]
+    env_idx = jnp.asarray(ENV_TABLE)[op]
     env_r = _onehot_gather(st.env, jnp.clip(env_idx, 0, N_ENV - 1))
     env_sid_r = _gather_flat(st.env_sid, jnp.clip(env_idx, 0, N_ENV - 1))
     pc_r = bv256.from_u32(st.pc.astype(jnp.uint32))
@@ -740,7 +888,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     )
     assert len(cases) == len(RESULT_CLASSES)
     which = jnp.broadcast_to(
-        RESULT_CLASS_TABLE[op][:, None], (n, bv256.NLIMBS)
+        jnp.asarray(RESULT_CLASS_TABLE)[op][:, None], (n, bv256.NLIMBS)
     )
     result = lax.select_n(which, *cases)
     result = jnp.where(defer[:, None], 0, result)
@@ -749,7 +897,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # passthroughs; else 0 (concrete)
     result_sid = jnp.where(defer, prov_id, 0)
     result_sid = jnp.where(
-        ~defer & (RESULT_CLASS_TABLE[op] == RESULT_CLASS_ID["ENV"]),
+        ~defer & (jnp.asarray(RESULT_CLASS_TABLE)[op] == RESULT_CLASS_ID["ENV"]),
         env_sid_r, result_sid)
     result_sid = jnp.where(
         ~defer & (op == _OP["CALLDATASIZE"]), st.cd_size_sid, result_sid)
@@ -781,26 +929,27 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # ---- deferred-record append (indexed row scatter: a dense one-hot
     # select would rewrite the whole (N,R,3,8) log plane every step) ------
     def _dlog_append():
-        pos = jnp.where(defer, jnp.clip(st.dlog_count, 0, d_recs - 1),
-                        d_recs)  # drop for non-deferring lanes
+        pos = jnp.where(logrec, jnp.clip(st.dlog_count, 0, d_recs - 1),
+                        d_recs)  # drop for non-logging lanes
         dop = st.dlog_op.at[lanes, pos].set(op, mode="drop")
         dpc = st.dlog_pc.at[lanes, pos].set(st.pc, mode="drop")
         dstep = st.dlog_step.at[lanes, pos].set(
             jnp.full((n,), st.step_no, jnp.int32), mode="drop")
+        dfen = st.dlog_fentry.at[lanes, pos].set(st.fentry, mode="drop")
         sids = jnp.stack([sid_a, sid_b, sid_c], axis=-1)  # (N, 3)
         vals = jnp.stack([a, b, c], axis=1)               # (N, 3, 8)
         dsid = st.dlog_sid.at[lanes, pos].set(sids, mode="drop")
         dval = st.dlog_val.at[lanes, pos].set(vals, mode="drop")
-        dcount = jnp.where(defer, st.dlog_count + 1, st.dlog_count)
-        return dop, dpc, dstep, dsid, dval, dcount
+        dcount = jnp.where(logrec, st.dlog_count + 1, st.dlog_count)
+        return dop, dpc, dstep, dfen, dsid, dval, dcount
 
-    dlog_op2, dlog_pc2, dlog_step2, dlog_sid2, dlog_val2, dlog_count2 = \
-        lax.cond(
-            jnp.any(defer),
-            _dlog_append,
-            lambda: (st.dlog_op, st.dlog_pc, st.dlog_step, st.dlog_sid,
-                     st.dlog_val, st.dlog_count),
-        )
+    (dlog_op2, dlog_pc2, dlog_step2, dlog_fentry2, dlog_sid2, dlog_val2,
+     dlog_count2) = lax.cond(
+        jnp.any(logrec),
+        _dlog_append,
+        lambda: (st.dlog_op, st.dlog_pc, st.dlog_step, st.dlog_fentry,
+                 st.dlog_sid, st.dlog_val, st.dlog_count),
+    )
 
     # ---- control flow -----------------------------------------------------
     next_pc = code.next_pc[pc_c]
@@ -829,13 +978,26 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         pos = jnp.clip(st.pclog_count, 0, p_recs - 1)
         psid = _scatter_flat(st.pclog_sid, fork_can, pos, sid_b)
         pneg = _scatter_flat(st.pclog_neg, fork_can, pos, zero_i)
+        # site metadata for drain-time detector adapters: the JUMPI's
+        # byte pc, global step, pre-execution gas interval, and active
+        # function entry (all host pre-hook parity)
+        ppc = _scatter_flat(st.pclog_pc, fork_can, pos, st.pc)
+        pstep = _scatter_flat(
+            st.pclog_step, fork_can, pos,
+            jnp.full((n,), st.step_no, jnp.int32))
+        pgmin = _scatter_flat(st.pclog_gmin, fork_can, pos, st.min_gas)
+        pgmax = _scatter_flat(st.pclog_gmax, fork_can, pos, st.max_gas)
+        pfen = _scatter_flat(st.pclog_fentry, fork_can, pos, st.fentry)
         pcount = jnp.where(fork_can, st.pclog_count + 1, st.pclog_count)
-        return psid, pneg, pcount
+        return psid, pneg, ppc, pstep, pgmin, pgmax, pfen, pcount
 
-    pclog_sid2, pclog_neg2, pclog_count2 = lax.cond(
+    (pclog_sid2, pclog_neg2, pclog_pc2, pclog_step2, pclog_gmin2,
+     pclog_gmax2, pclog_fentry2, pclog_count2) = lax.cond(
         jnp.any(fork_can),
         _pclog_append,
-        lambda: (st.pclog_sid, st.pclog_neg, st.pclog_count),
+        lambda: (st.pclog_sid, st.pclog_neg, st.pclog_pc, st.pclog_step,
+                 st.pclog_gmin, st.pclog_gmax, st.pclog_fentry,
+                 st.pclog_count),
     )
 
     # ---- gas / status / bookkeeping ---------------------------------------
@@ -848,6 +1010,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         sp=jnp.where(ok, new_sp, st.sp),
         depth=new_depth,
         fentry=new_fentry,
+        last_jump=jnp.where(ok & is_jump, st.pc, st.last_jump),
         stack=stack,
         ssid=ssid,
         memory=memory,
@@ -871,11 +1034,17 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         dlog_op=dlog_op2,
         dlog_pc=dlog_pc2,
         dlog_step=dlog_step2,
+        dlog_fentry=dlog_fentry2,
         dlog_sid=dlog_sid2,
         dlog_val=dlog_val2,
         dlog_count=dlog_count2,
         pclog_sid=pclog_sid2,
         pclog_neg=pclog_neg2,
+        pclog_pc=pclog_pc2,
+        pclog_step=pclog_step2,
+        pclog_gmin=pclog_gmin2,
+        pclog_gmax=pclog_gmax2,
+        pclog_fentry=pclog_fentry2,
         pclog_count=pclog_count2,
         step_no=st.step_no + 1,
     )
@@ -943,11 +1112,14 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
 
 def sym_run(code: CompiledCode, st: SymLaneState, max_steps: int,
-            exec_table: jnp.ndarray = None) -> SymLaneState:
+            exec_table: jnp.ndarray = None,
+            taint_table: jnp.ndarray = None) -> SymLaneState:
     """Run up to max_steps (one sync window). max_steps must not exceed
     the deferred-log capacity (one record per lane per step)."""
     if exec_table is None:
         exec_table = SYM_EXECUTABLE
+    if taint_table is None:
+        taint_table = np.zeros(256, bool)
 
     def cond(carry):
         s, i = carry
@@ -955,7 +1127,7 @@ def sym_run(code: CompiledCode, st: SymLaneState, max_steps: int,
 
     def body(carry):
         s, i = carry
-        return sym_step(code, s, exec_table), i + 1
+        return sym_step(code, s, exec_table, taint_table), i + 1
 
     final, _ = lax.while_loop(cond, body, (st, jnp.int32(0)))
     return final

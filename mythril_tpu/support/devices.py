@@ -12,6 +12,28 @@ which _clear_backends does not clear).
 import os
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: the lane-engine kernels take
+    tens of seconds to compile; caching them across processes makes CLI
+    runs pay it once per kernel shape, not once per invocation."""
+    import jax
+
+    import getpass
+    import tempfile
+
+    cache_dir = os.path.join(
+        tempfile.gettempdir(),
+        f"mythril_tpu_jax_cache_{getpass.getuser()}",
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even sub-second kernels: on a tunneled backend each
+        # compile is a network round trip, so "fast" compiles aren't
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:  # already set / unsupported — never fatal
+        pass
+
+
 def force_virtual_cpu(n_devices: int) -> None:
     """Rebuild JAX as an n-device virtual CPU platform, tearing down any
     already-initialized backend."""

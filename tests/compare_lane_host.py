@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Lane-engine vs host report comparison over the reference fixture
+corpus, with the FULL default detector set. Usage:
+
+    python tests/compare_lane_host.py [fixture ...]
+
+Runs `myth analyze -o json` twice per fixture (host, --tpu-lanes) and
+diffs the issue lists (minus discovery time ordering artifacts). Exits
+nonzero on any mismatch. Also prints per-fixture wall clocks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+INPUTS = pathlib.Path("/root/reference/tests/testdata/inputs")
+CREATION_FIXTURES = {
+    "flag_array.sol.o",
+    "exceptions_0.8.0.sol.o",
+    "symbolic_exec_bytecode.sol.o",
+    "extcall.sol.o",
+}
+
+
+def run(path: pathlib.Path, lanes: int, timeout=900):
+    cmd = [
+        sys.executable, str(REPO / "myth"), "analyze",
+        "-f", str(path), "-t", "2", "--no-onchain-data",
+        "-o", "json", "--solver-timeout", "15000",
+    ]
+    if path.name not in CREATION_FIXTURES:
+        cmd.append("--bin-runtime")
+    if lanes:
+        cmd += ["--tpu-lanes", str(lanes)]
+    t0 = time.time()
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        cwd=str(REPO), env={**os.environ},
+    )
+    dt = time.time() - t0
+    try:
+        rep = json.loads(out.stdout)
+    except json.JSONDecodeError:
+        print(out.stdout[-2000:])
+        print(out.stderr[-3000:])
+        raise
+    return rep, dt, out.stderr
+
+
+def canon(report):
+    issues = []
+    for i in report.get("issues") or []:
+        i = dict(i)
+        i.pop("discoveryTime", None)
+        issues.append(i)
+    return sorted(issues, key=lambda i: json.dumps(i, sort_keys=True))
+
+
+def main():
+    names = sys.argv[1:] or sorted(
+        p.name for p in INPUTS.glob("*.sol.o"))
+    lanes = int(os.environ.get("LANES", "64"))
+    bad = 0
+    th = tl = 0.0
+    for name in names:
+        path = INPUTS / name
+        host, t_host, _ = run(path, 0)
+        lane, t_lane, err = run(path, lanes)
+        th += t_host
+        tl += t_lane
+        ch, cl = canon(host), canon(lane)
+        status = "OK " if ch == cl else "DIFF"
+        if ch != cl:
+            bad += 1
+        print(f"{status} {name:32s} host {len(ch)}i {t_host:6.1f}s  "
+              f"lane {len(cl)}i {t_lane:6.1f}s")
+        if ch != cl:
+            hk = {(i['swc-id'], i['address'], i.get('function'))
+                  for i in ch}
+            lk = {(i['swc-id'], i['address'], i.get('function'))
+                  for i in cl}
+            for k in sorted(hk - lk, key=str):
+                print("   host only:", k)
+            for k in sorted(lk - hk, key=str):
+                print("   lane only:", k)
+            if hk == lk:
+                print("   (same issue keys; field-level diff)")
+    print(f"TOTAL host {th:.1f}s lane {tl:.1f}s  -> "
+          f"{'PASS' if not bad else f'{bad} DIFFS'}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,9 +16,8 @@ from mythril_tpu.support.devices import force_virtual_cpu  # noqa: E402
 
 force_virtual_cpu(8)
 
-import jax  # noqa: E402  (pre-imported by sitecustomize; config still open)
-
 # Persistent compilation cache: the interval/stepper kernels compile in
 # tens of seconds; caching them across test runs keeps the suite fast.
-jax.config.update("jax_compilation_cache_dir", "/tmp/mythril_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+from mythril_tpu.support.devices import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

@@ -1,0 +1,94 @@
+"""Lane-engine adapter parity: with the FULL default detector set, the
+`--tpu-lanes` path must produce the same report as the host interpreter.
+This exercises the drain-time detector adapters
+(analysis/module/lane_adapters.py): env-taint seeding (ORIGIN,
+TIMESTAMP/NUMBER/COINBASE/GASLIMIT), arithmetic overflow annotation at
+record resolution, JUMPI site firing, SSTORE sink promotion, and the
+last-jump plane for the exceptions module.
+
+The CLI-level corpus sweep (tests/compare_lane_host.py) covers all 18
+reference fixtures; this keeps a fast representative subset in CI."""
+
+import json
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+from mythril_tpu.orchestration.mythril_disassembler import (
+    MythrilDisassembler,
+)
+from mythril_tpu.support.support_args import args as global_args
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+
+# small fixtures that exercise origin/integer/exceptions adapters
+FIXTURES = ["origin.sol.o", "underflow.sol.o", "exceptions.sol.o"]
+
+
+def _reset_modules():
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules(None, None):
+        m.reset_module()
+        m.cache.clear()
+
+
+def _analyze(file_name, tpu_lanes):
+    _reset_modules()
+    disassembler = MythrilDisassembler(eth=None)
+    code = (INPUTS / file_name).read_text().strip()
+    address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
+    cmd_args = SimpleNamespace(
+        execution_timeout=600,
+        max_depth=128,
+        solver_timeout=25000,
+        no_onchain_data=True,
+        loop_bound=3,
+        create_timeout=10,
+        pruning_factor=None,
+        unconstrained_storage=False,
+        parallel_solving=False,
+        call_depth_limit=3,
+        disable_dependency_pruning=False,
+        custom_modules_directory="",
+        solver_log=None,
+        transaction_sequences=None,
+        tpu_lanes=tpu_lanes,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+    try:
+        report = analyzer.fire_lasers(modules=None, transaction_count=2)
+    finally:
+        global_args.tpu_lanes = 0
+    out = json.loads(report.as_json())
+    for issue in out.get("issues") or []:
+        issue.pop("discoveryTime", None)
+    out["issues"] = sorted(
+        out.get("issues") or [],
+        key=lambda i: json.dumps(i, sort_keys=True))
+    return out["issues"]
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+@pytest.mark.parametrize("file_name", FIXTURES)
+def test_full_module_lane_parity(file_name):
+    from mythril_tpu.laser import lane_engine
+
+    host = _analyze(file_name, 0)
+    lane_engine.LAST_RUN_STATS = None
+    lane = _analyze(file_name, 16)
+    # comparing against a silent host fallback would be vacuous: the
+    # device path must actually have executed
+    stats = lane_engine.LAST_RUN_STATS
+    assert stats and stats["seeded"] > 0 and stats["device_steps"] > 0, (
+        f"lane engine did not run: {stats}"
+    )
+    assert host == lane, (
+        f"{file_name}: host {len(host)} issues, lane {len(lane)} issues"
+    )
+    assert host, f"{file_name}: expected at least one issue"
