@@ -5,9 +5,8 @@ All precompiles operate on concrete byte lists; symbolic input raises
 NativeContractException and the caller substitutes fresh symbolic output
 bytes (reference call.py:238-249). Crypto backends are this build's own
 pure-Python implementations (mythril_tpu/utils/crypto.py) instead of the
-coincurve/py_ecc/blake2b wheels. bn128 pairing is conservatively modeled:
-it raises NativeContractException (-> symbolic output) until the full Fq12
-tower lands."""
+coincurve/py_ecc/blake2b wheels, including an exact bn128 ecPairing
+(own Fq2/Fq12 tower + optimal-ate Miller loop)."""
 
 import hashlib
 import logging
@@ -138,8 +137,28 @@ def ec_mul(data: List[int]) -> List[int]:
 
 
 def ec_pair(data: List[int]) -> List[int]:
-    # Pairing check needs the Fq12 tower; treat as symbolic for now.
-    raise NativeContractException
+    """EIP-197 ecPairing product check (capability parity:
+    mythril/laser/ethereum/natives.py:204-236; EVM supplies each G2
+    coordinate imaginary-part-first)."""
+    if len(data) % 192:
+        return []
+    pairs = []
+    bytes_data = bytearray(data)
+    for i in range(0, len(bytes_data), 192):
+        x1 = extract32(bytes_data, i)
+        y1 = extract32(bytes_data, i + 32)
+        x2_i = extract32(bytes_data, i + 64)
+        x2_r = extract32(bytes_data, i + 96)
+        y2_i = extract32(bytes_data, i + 128)
+        y2_r = extract32(bytes_data, i + 160)
+        try:
+            p1 = crypto.bn128_decode_point(x1, y1)
+            q2 = crypto.bn128_g2_decode(x2_r, x2_i, y2_r, y2_i)
+        except ValueError:
+            return []
+        pairs.append((p1, q2))
+    result = crypto.bn128_pairing_check(pairs)
+    return [0] * 31 + [1 if result else 0]
 
 
 def blake2b_fcompress(data: List[int]) -> List[int]:

@@ -143,6 +143,294 @@ def bn128_encode_point(pt) -> Tuple[int, int]:
     return pt
 
 
+# --- bn128 pairing (EIP-197 ecPairing) -------------------------------------
+# Optimal-ate pairing over alt_bn128 with the standard tower:
+# Fq2 = Fq[u]/(u^2+1), Fq12 = Fq[w]/(w^12 - 18 w^6 + 82), G2 on the
+# sextic twist y^2 = x^3 + 3/(9+u). Pure big-int polynomial arithmetic —
+# ecPairing calls are rare (one per concrete CALL to precompile 8), so
+# clarity beats speed here. Capability parity:
+# mythril/laser/ethereum/natives.py:204-236 (py_ecc-backed ec_pair).
+
+
+class _FQP:
+    """Element of Fq[x]/(modulus); coeffs are ints mod BN_P."""
+
+    degree = 0
+    modulus_coeffs: Tuple[int, ...] = ()
+
+    __slots__ = ("coeffs",)
+
+    def __init__(self, coeffs):
+        self.coeffs = tuple(c % BN_P for c in coeffs)
+        assert len(self.coeffs) == self.degree
+
+    @classmethod
+    def one(cls):
+        return cls((1,) + (0,) * (cls.degree - 1))
+
+    @classmethod
+    def zero(cls):
+        return cls((0,) * cls.degree)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.coeffs == other.coeffs
+
+    def __hash__(self):
+        return hash(self.coeffs)
+
+    def __add__(self, other):
+        return type(self)(
+            [a + b for a, b in zip(self.coeffs, other.coeffs)])
+
+    def __sub__(self, other):
+        return type(self)(
+            [a - b for a, b in zip(self.coeffs, other.coeffs)])
+
+    def __neg__(self):
+        return type(self)([-a for a in self.coeffs])
+
+    def __mul__(self, other):
+        d = self.degree
+        if isinstance(other, int):
+            return type(self)([a * other for a in self.coeffs])
+        prod = [0] * (2 * d - 1)
+        for i, a in enumerate(self.coeffs):
+            if not a:
+                continue
+            for j, b in enumerate(other.coeffs):
+                prod[i + j] += a * b
+        # reduce by x^d = -(modulus_coeffs)
+        for i in range(2 * d - 2, d - 1, -1):
+            top = prod[i]
+            if not top:
+                continue
+            base = i - d
+            for j, m in enumerate(self.modulus_coeffs):
+                if m:
+                    prod[base + j] -= top * m
+        return type(self)(prod[:d])
+
+    __rmul__ = __mul__
+
+    def inv(self):
+        """Extended Euclid over Fq[x] against the modulus polynomial."""
+        d = self.degree
+        lm, hm = [1] + [0] * d, [0] * (d + 1)
+        low = list(self.coeffs) + [0]
+        high = list(self.modulus_coeffs) + [1]
+
+        def deg(p):
+            for i in range(len(p) - 1, -1, -1):
+                if p[i]:
+                    return i
+            return 0
+
+        def poly_rounded_div(a, b):
+            dega, degb = deg(a), deg(b)
+            temp = list(a)
+            out = [0] * len(a)
+            binv = pow(b[degb], -1, BN_P)
+            for i in range(dega - degb, -1, -1):
+                out[i] = (out[i] + temp[degb + i] * binv) % BN_P
+                for c in range(degb + 1):
+                    temp[c + i] = (temp[c + i] - out[i] * b[c]) % BN_P
+            return out[: deg(out) + 1]
+
+        while deg(low):
+            r = poly_rounded_div(high, low)
+            r += [0] * (d + 1 - len(r))
+            nm = list(hm)
+            new = list(high)
+            for i in range(d + 1):
+                for j in range(d + 1 - i):
+                    nm[i + j] -= lm[i] * r[j]
+                    new[i + j] -= low[i] * r[j]
+            nm = [x % BN_P for x in nm]
+            new = [x % BN_P for x in new]
+            lm, low, hm, high = nm, new, lm, low
+        inv0 = pow(low[0], -1, BN_P)
+        return type(self)([c * inv0 % BN_P for c in lm[:d]])
+
+    def __truediv__(self, other):
+        if isinstance(other, int):
+            return self * pow(other, -1, BN_P)
+        return self * other.inv()
+
+    def __pow__(self, exponent: int):
+        result = type(self).one()
+        base = self
+        while exponent:
+            if exponent & 1:
+                result = result * base
+            base = base * base
+            exponent >>= 1
+        return result
+
+    def __repr__(self):
+        return f"{type(self).__name__}{self.coeffs}"
+
+
+class FQ2(_FQP):
+    degree = 2
+    modulus_coeffs = (1, 0)  # u^2 = -1
+
+
+class FQ12(_FQP):
+    degree = 12
+    modulus_coeffs = (82, 0, 0, 0, 0, 0, -18, 0, 0, 0, 0, 0)
+    # w^12 = -82 + 18 w^6
+
+
+# G2 generator (standard alt_bn128 constants; coeffs are (real, imag))
+BN_G2 = (
+    FQ2((
+        10857046999023057135944570762232829481370756359578518086990519993285655852781,
+        11559732032986387107991004021392285783925812861821192530917403151452391805634,
+    )),
+    FQ2((
+        8495653923123431417604973247489272438418190587263600148770280649306958101930,
+        4082367875863433681332203403145435568316851327593401208105741076214120093531,
+    )),
+)
+BN_B2 = FQ2((3, 0)) / FQ2((9, 1))  # twist curve coefficient
+
+_ATE_LOOP_COUNT = 29793968203157093288
+_LOG_ATE = 63
+
+
+def _ec2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return y * y - x * x * x == BN_B2
+
+
+def _ecf_add(p1, p2):
+    """Affine addition, generic over the field (FQ2/FQ12 points)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == y2:
+            lam = (3 * (x1 * x1)) / (2 * y1)
+        else:
+            return None
+    else:
+        lam = (y2 - y1) / (x2 - x1)
+    x3 = lam * lam - x1 - x2
+    return (x3, lam * (x1 - x3) - y1)
+
+
+def _ecf_mul(pt, scalar: int):
+    result = None
+    addend = pt
+    while scalar:
+        if scalar & 1:
+            result = _ecf_add(result, addend)
+        addend = _ecf_add(addend, addend)
+        scalar >>= 1
+    return result
+
+
+def _ecf_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], -pt[1])
+
+
+_W2 = FQ12((0, 0, 1) + (0,) * 9)   # w^2
+_W3 = FQ12((0, 0, 0, 1) + (0,) * 8)  # w^3
+
+
+def _twist(pt):
+    """G2 (FQ2) -> curve over FQ12 via the sextic untwist."""
+    if pt is None:
+        return None
+    x, y = pt
+    xc = (x.coeffs[0] - 9 * x.coeffs[1], x.coeffs[1])
+    yc = (y.coeffs[0] - 9 * y.coeffs[1], y.coeffs[1])
+    nx = FQ12((xc[0],) + (0,) * 5 + (xc[1],) + (0,) * 5)
+    ny = FQ12((yc[0],) + (0,) * 5 + (yc[1],) + (0,) * 5)
+    return (nx * _W2, ny * _W3)
+
+
+def _cast_g1_fq12(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (FQ12((x,) + (0,) * 11), FQ12((y,) + (0,) * 11))
+
+
+def _linefunc(p1, p2, t):
+    x1, y1 = p1
+    x2, y2 = p2
+    xt, yt = t
+    if x1 != x2:
+        m = (y2 - y1) / (x2 - x1)
+        return m * (xt - x1) - (yt - y1)
+    if y1 == y2:
+        m = (3 * (x1 * x1)) / (2 * y1)
+        return m * (xt - x1) - (yt - y1)
+    return xt - x1
+
+
+def _miller_loop(q, p):
+    if q is None or p is None:
+        return FQ12.one()
+    r = q
+    f = FQ12.one()
+    for i in range(_LOG_ATE, -1, -1):
+        f = f * f * _linefunc(r, r, p)
+        r = _ecf_add(r, r)
+        if _ATE_LOOP_COUNT & (2 ** i):
+            f = f * _linefunc(r, q, p)
+            r = _ecf_add(r, q)
+    # Frobenius endomorphism steps (coordinates are FQ12 already)
+    q1 = (q[0] ** BN_P, q[1] ** BN_P)
+    nq2 = (q1[0] ** BN_P, -(q1[1] ** BN_P))
+    f = f * _linefunc(r, q1, p)
+    r = _ecf_add(r, q1)
+    f = f * _linefunc(r, nq2, p)
+    return f
+
+
+def bn128_pairing_factor(q2, p1) -> FQ12:
+    """Miller-loop factor (no final exponentiation) of e(p1, q2):
+    q2 an FQ2 G2 point (or None), p1 an int-pair G1 point (or None)."""
+    return _miller_loop(_twist(q2), _cast_g1_fq12(p1))
+
+
+def bn128_final_exponentiate(f: FQ12) -> FQ12:
+    return f ** ((BN_P ** 12 - 1) // BN_N)
+
+
+def bn128_g2_decode(x_r: int, x_i: int, y_r: int, y_i: int):
+    """Validate and decode a G2 point; (0,0) is infinity. Raises
+    ValueError off-curve / out-of-field / outside the r-torsion."""
+    for v in (x_r, x_i, y_r, y_i):
+        if v >= BN_P:
+            raise ValueError("G2 coordinate out of field")
+    if x_r == x_i == y_r == y_i == 0:
+        return None
+    pt = (FQ2((x_r, x_i)), FQ2((y_r, y_i)))
+    if not _ec2_is_on_curve(pt):
+        raise ValueError("G2 point not on curve")
+    if _ecf_mul(pt, BN_N) is not None:
+        raise ValueError("G2 point not in the r-torsion subgroup")
+    return pt
+
+
+def bn128_pairing_check(pairs) -> bool:
+    """EIP-197 product check: prod e(p1_i, q2_i) == 1."""
+    f = FQ12.one()
+    for p1, q2 in pairs:
+        f = f * bn128_pairing_factor(q2, p1)
+    return bn128_final_exponentiate(f) == FQ12.one()
+
+
 # --- blake2b compression (EIP-152 F function) ------------------------------
 
 _B2B_IV = [

@@ -1,0 +1,101 @@
+"""bn128 ecPairing precompile (EIP-197) — exactness tests via pairing
+identities, plus the reference's own error-path oracles
+(mythril/laser/ethereum/natives.py:204-236,
+tests/laser/Precompiles/test_elliptic_curves.py)."""
+
+from mythril_tpu.laser.natives import ec_pair
+from mythril_tpu.utils import crypto
+
+
+def _b32(v: int) -> bytes:
+    return v.to_bytes(32, "big")
+
+
+def _g1_bytes(pt) -> bytes:
+    x, y = crypto.bn128_encode_point(pt)
+    return _b32(x) + _b32(y)
+
+
+def _g2_bytes(pt) -> bytes:
+    if pt is None:
+        return _b32(0) * 4
+    x, y = pt
+    # EVM order: imaginary part first
+    return (_b32(x.coeffs[1]) + _b32(x.coeffs[0])
+            + _b32(y.coeffs[1]) + _b32(y.coeffs[0]))
+
+
+G1 = (1, 2)
+NEG_G1 = (1, crypto.BN_P - 2)
+G2 = crypto.BN_G2
+SUCCESS = [0] * 31 + [1]
+FAILURE = [0] * 31 + [0]
+
+
+def _pairs(*pairs) -> list:
+    out = b"".join(_g1_bytes(p) + _g2_bytes(q) for p, q in pairs)
+    return list(out)
+
+
+def test_pair_cancellation():
+    # e(P, Q) * e(-P, Q) == 1
+    assert ec_pair(_pairs((G1, G2), (NEG_G1, G2))) == SUCCESS
+
+
+def test_pair_bilinearity():
+    # e(2P, 3Q) * e(-6P, Q) == 1
+    p2 = crypto.bn128_mul(G1, 2)
+    p6 = crypto.bn128_mul(G1, 6)
+    q3 = crypto._ecf_mul(G2, 3)
+    neg_p6 = (p6[0], crypto.BN_P - p6[1])
+    assert ec_pair(_pairs((p2, q3), (neg_p6, G2))) == SUCCESS
+
+
+def test_pair_nonmatching():
+    p2 = crypto.bn128_mul(G1, 2)
+    assert ec_pair(_pairs((p2, G2), (NEG_G1, G2))) == FAILURE
+
+
+def test_pair_infinity_pairs():
+    # empty input and pairs with a point at infinity are trivially 1
+    assert ec_pair([]) == SUCCESS
+    assert ec_pair(_pairs((None, G2), (G1, None))) == SUCCESS
+
+
+def test_pair_length_check():
+    # reference oracle: non-multiple-of-192 input fails
+    assert ec_pair([0] * 191) == []
+
+
+def test_pair_invalid_g1():
+    bad = _b32(1) + _b32(3) + _g2_bytes(G2)  # (1,3) not on curve
+    assert ec_pair(list(bad)) == []
+
+
+def test_pair_field_exceeded():
+    bad = _g1_bytes(G1) + _b32(crypto.BN_P) + _b32(0) * 3
+    assert ec_pair(list(bad)) == []
+
+
+def test_pair_g2_not_on_curve():
+    bad = _g1_bytes(G1) + _b32(1) + _b32(2) + _b32(3) + _b32(4)
+    assert ec_pair(list(bad)) == []
+
+
+def test_pair_g2_wrong_subgroup():
+    # a precomputed point ON the twist curve but OUTSIDE the r-torsion
+    # (the twist's cofactor is > 1, so such points exist); EIP-197
+    # requires rejecting them
+    pt = (
+        crypto.FQ2((2, 1)),
+        crypto.FQ2((
+            7292567877523311580221095596750716176434782432868683424513645834767876293070,
+            19659275751359636165940301690575149581329631496732780143538578556285923319774,
+        )),
+    )
+    assert crypto._ec2_is_on_curve(pt)
+    assert crypto._ecf_mul(pt, crypto.BN_N) is not None
+    bad = _g1_bytes(G1) + (
+        _b32(pt[0].coeffs[1]) + _b32(pt[0].coeffs[0])
+        + _b32(pt[1].coeffs[1]) + _b32(pt[1].coeffs[0]))
+    assert ec_pair(list(bad)) == []
