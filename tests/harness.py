@@ -80,14 +80,16 @@ def committed_storage(laser, slot: int, addr: int = ADDR) -> int:
 
 
 def analyze_runtime(runtime_hex: str, modules, tx_count=1, name="test",
-                    max_depth=64):
-    """Symbolically analyze runtime bytecode with the given detection
-    modules; returns the issues (shared by the detector/e2e tests)."""
+                    max_depth=64, contract=None):
+    """Symbolically analyze runtime bytecode (or a prebuilt contract
+    object) with the given detection modules; returns the issues
+    (shared by the detector/e2e/front-end tests)."""
     from mythril_tpu.analysis.security import fire_lasers
     from mythril_tpu.analysis.symbolic import SymExecWrapper
     from mythril_tpu.ethereum.evmcontract import EVMContract
 
-    contract = EVMContract(code=runtime_hex, name=name)
+    if contract is None:
+        contract = EVMContract(code=runtime_hex, name=name)
     sym = SymExecWrapper(
         contract,
         address=0xDEADBEEF,

@@ -1,0 +1,181 @@
+"""Solidity front-end proof without a solc binary: a canned
+solc-standard-JSON unit (real reference runtime bytecode + a
+synthesized creation wrapper + a programmatically constructed srcmap)
+drives SolidityContract end to end — construction, compressed-srcmap
+decoding, instruction-address -> source-line mapping, and a
+source-mapped issue through the full analyzer (capability parity:
+mythril/solidity/soliditycontract.py:168-386,
+mythril/ethereum/util.py:41-108)."""
+
+import json
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+import mythril_tpu.solidity.soliditycontract as sc_mod
+from mythril_tpu.disassembler.disassembly import Disassembly
+from mythril_tpu.solidity.soliditycontract import SolidityContract
+
+REF = Path("/root/reference/tests/testdata")
+SOURCE_FILE = REF / "input_contracts" / "suicide.sol"
+RUNTIME_FILE = REF / "inputs" / "suicide.sol.o"
+
+
+def _creation_wrapper(runtime_hex: str) -> str:
+    """Minimal deploy prologue: PUSH2 len DUP1 PUSH1 0C PUSH1 00
+    CODECOPY PUSH1 00 RETURN (12 bytes), then the runtime code."""
+    runtime = bytes.fromhex(runtime_hex)
+    wrapper = (
+        b"\x61" + len(runtime).to_bytes(2, "big")  # PUSH2 len
+        + b"\x80\x60\x0c\x60\x00\x39\x60\x00\xf3"
+    )
+    assert len(wrapper) == 12
+    return (wrapper + runtime).hex()
+
+
+def _build_fixture(tmp_path: Path):
+    """A standard-JSON unit whose srcmap is generated against the real
+    disassembly: default-maps every instruction to the whole source,
+    maps the SELFDESTRUCT site to the `selfdestruct(...)` statement and
+    the first JUMPDEST to the function definition line."""
+    source = SOURCE_FILE.read_text()
+    runtime_hex = RUNTIME_FILE.read_text().strip().replace("0x", "")
+    src_path = tmp_path / "suicide.sol"
+    src_path.write_text(source)
+
+    disas = Disassembly(runtime_hex)
+    n = len(disas.instruction_list)
+    sd_index = next(i for i, ins in enumerate(disas.instruction_list)
+                    if ins["opcode"] == "SELFDESTRUCT")
+    jd_index = next(i for i, ins in enumerate(disas.instruction_list)
+                    if ins["opcode"] == "JUMPDEST")
+
+    sd_off = source.find("selfdestruct")
+    sd_len = source.find(";", sd_off) + 1 - sd_off
+    fn_off = source.find("function kill")
+    fn_len = source.find("}", fn_off) + 1 - fn_off
+    assert sd_off > 0 and fn_off > 0
+
+    # compressed solc srcmap: full fields on change, empty-field
+    # inheritance otherwise (exercises decode_srcmap's decompression)
+    entries = []
+    for i in range(n):
+        if i == 0:
+            entries.append(f"0:{len(source)}:0:-")
+        elif i == jd_index:
+            entries.append(f"{fn_off}:{fn_len}")
+        elif i == jd_index + 1:
+            entries.append(f"0:{len(source)}")
+        elif i == sd_index:
+            entries.append(f"{sd_off}:{sd_len}")
+        elif i == sd_index + 1:
+            entries.append(f"0:{len(source)}")
+        else:
+            entries.append("")
+    srcmap = ";".join(entries)
+
+    creation_hex = _creation_wrapper(runtime_hex)
+    n_ctor = len(Disassembly(creation_hex).instruction_list)
+    ctor_srcmap = ";".join(
+        [f"0:{len(source)}:0:-"] + [""] * (n_ctor - 1))
+
+    data = {
+        "contracts": {
+            str(src_path): {
+                "Suicide": {
+                    "abi": [],
+                    "evm": {
+                        "bytecode": {
+                            "object": creation_hex,
+                            "sourceMap": ctor_srcmap,
+                        },
+                        "deployedBytecode": {
+                            "object": runtime_hex,
+                            "sourceMap": srcmap,
+                        },
+                    },
+                }
+            }
+        },
+        "sources": {str(src_path): {"id": 0}},
+    }
+    return src_path, data, disas, sd_index, jd_index, source
+
+
+@pytest.fixture
+def canned(tmp_path, monkeypatch):
+    src_path, data, disas, sd_index, jd_index, source = \
+        _build_fixture(tmp_path)
+    monkeypatch.setattr(sc_mod, "get_solc_json",
+                        lambda *a, **k: data)
+    contract = SolidityContract(str(src_path))
+    return SimpleNamespace(
+        contract=contract, disas=disas, sd_index=sd_index,
+        jd_index=jd_index, source=source, src_path=src_path,
+    )
+
+
+@pytest.mark.skipif(not (SOURCE_FILE.exists() and RUNTIME_FILE.exists()),
+                    reason="no fixtures")
+def test_contract_construction(canned):
+    c = canned.contract
+    assert c.name == "Suicide"
+    assert c.code == RUNTIME_FILE.read_text().strip().replace("0x", "")
+    assert c.creation_code.endswith(c.code)
+    # the compressed srcmap decompresses to one entry per instruction
+    assert len(c.srcmap) == len(canned.disas.instruction_list)
+
+
+@pytest.mark.skipif(not (SOURCE_FILE.exists() and RUNTIME_FILE.exists()),
+                    reason="no fixtures")
+def test_selfdestruct_maps_to_source_line(canned):
+    c = canned.contract
+    sd_addr = canned.disas.instruction_list[canned.sd_index]["address"]
+    info = c.get_source_info(sd_addr)
+    assert info is not None
+    assert info.code.startswith("selfdestruct")
+    expected_line = canned.source.count(
+        "\n", 0, canned.source.find("selfdestruct")) + 1
+    assert info.lineno == expected_line
+    assert str(canned.src_path) in info.filename
+
+
+@pytest.mark.skipif(not (SOURCE_FILE.exists() and RUNTIME_FILE.exists()),
+                    reason="no fixtures")
+def test_function_entry_maps_to_definition(canned):
+    c = canned.contract
+    jd_addr = canned.disas.instruction_list[canned.jd_index]["address"]
+    info = c.get_source_info(jd_addr)
+    assert info.code.startswith("function kill")
+
+
+@pytest.mark.skipif(not (SOURCE_FILE.exists() and RUNTIME_FILE.exists()),
+                    reason="no fixtures")
+def test_constructor_srcmap(canned):
+    info = canned.contract.get_source_info(0, constructor=True)
+    assert info is not None and info.lineno == 1
+
+
+@pytest.mark.skipif(not (SOURCE_FILE.exists() and RUNTIME_FILE.exists()),
+                    reason="no fixtures")
+def test_source_mapped_issue(canned):
+    """Full pipeline: analyze the canned contract and check the
+    reported issue carries the srcmap-resolved source line."""
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    from .harness import analyze_runtime
+
+    for m in ModuleLoader().get_detection_modules(None, None):
+        m.reset_module()
+        m.cache.clear()
+    c = canned.contract
+    issues = analyze_runtime(
+        None, ["AccidentallyKillable"], max_depth=128, contract=c)
+    assert issues, "expected an unprotected-selfdestruct issue"
+    issue = issues[0]
+    issue.add_code_info(c)
+    assert issue.code.startswith("selfdestruct")
+    expected_line = canned.source.count(
+        "\n", 0, canned.source.find("selfdestruct")) + 1
+    assert issue.lineno == expected_line
