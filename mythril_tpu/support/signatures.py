@@ -106,9 +106,17 @@ class SignatureDB(object, metaclass=type):
         )
         self._seed()
 
+    #: bump when the seed contents change so existing databases pick
+    #: up the new pack (rows are INSERT OR IGNORE — re-seeding is safe)
+    SEED_VERSION = 2
+
     def _seed(self) -> None:
-        cur = self.conn.execute("SELECT COUNT(*) FROM signatures")
-        if cur.fetchone()[0] > 0:
+        self.conn.execute(
+            "CREATE TABLE IF NOT EXISTS seed_meta (version INTEGER)"
+        )
+        cur = self.conn.execute("SELECT MAX(version) FROM seed_meta")
+        row = cur.fetchone()
+        if row and row[0] is not None and row[0] >= self.SEED_VERSION:
             return
         from .support_utils import sha3
 
@@ -116,9 +124,28 @@ class SignatureDB(object, metaclass=type):
         for sig in COMMON_SIGNATURES:
             selector = "0x" + sha3(sig.encode())[:4].hex()
             rows.append((selector, sig))
+        # generated offline seed pack (tools/gen_signatures.py) — the
+        # counterpart of the reference's shipped signatures.db asset
+        # (mythril/mythril/mythril_config.py:52-58): lets offline runs
+        # resolve real function names instead of _function_0x… stubs
+        asset = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "assets", "signatures.txt",
+        )
+        try:
+            with open(asset) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) == 2 and parts[0].startswith("0x"):
+                        rows.append((parts[0].lower(), parts[1]))
+        except OSError:
+            log.debug("no signature seed pack at %s", asset)
         self.conn.executemany(
             "INSERT OR IGNORE INTO signatures VALUES (?, ?)", rows
         )
+        self.conn.execute("DELETE FROM seed_meta")
+        self.conn.execute("INSERT INTO seed_meta VALUES (?)",
+                          (self.SEED_VERSION,))
         self.conn.commit()
 
     def get(self, byte_sig: str) -> List[str]:

@@ -10,6 +10,8 @@ setup(
         "TPU-native symbolic-execution security analyzer for EVM bytecode"
     ),
     packages=find_packages(include=["mythril_tpu", "mythril_tpu.*"]),
+    package_data={"mythril_tpu.support": ["assets/*.txt"]},
+    include_package_data=True,
     python_requires=">=3.9",
     install_requires=[
         "jax",

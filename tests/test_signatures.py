@@ -1,0 +1,37 @@
+"""Offline signature resolution: the generated seed pack
+(tools/gen_signatures.py -> support/assets/signatures.txt) must let
+SignatureDB resolve fixture selectors without any network access
+(reference analog: the shipped signatures.db asset,
+mythril/mythril/mythril_config.py:52-58)."""
+
+import os
+import tempfile
+
+from mythril_tpu.support.signatures import SignatureDB
+
+
+def _fresh_db(tmpdir):
+    # bypass the singleton for an isolated database file
+    db = object.__new__(SignatureDB)
+    db._initialized = False
+    db.__init__(path=os.path.join(tmpdir, "sigs.db"))
+    return db
+
+
+def test_seed_pack_loaded():
+    with tempfile.TemporaryDirectory() as td:
+        db = _fresh_db(td)
+        n = db.conn.execute(
+            "SELECT COUNT(*) FROM signatures").fetchone()[0]
+        assert n > 50, f"seed pack missing ({n} rows)"
+        # fixture-derived and canonical selectors resolve offline
+        assert db.get("0xab125858") == ["extractMoney(uint256)"]
+        assert "transfer(address,uint256)" in db.get("0xa9059cbb")
+
+
+def test_selector_keccak_correct():
+    # the generator computes selectors with this build's own keccak;
+    # spot-check against the universally known ERC-20 transfer selector
+    from mythril_tpu.support.support_utils import sha3
+
+    assert sha3(b"transfer(address,uint256)")[:4].hex() == "a9059cbb"

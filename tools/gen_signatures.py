@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Generate the offline function-signature seed pack.
+
+Harvests `function name(args)` declarations from Solidity sources
+(default: the reference's fixture/example corpora), canonicalizes the
+argument types, computes the 4-byte keccak selectors with this build's
+own keccak, and writes `mythril_tpu/support/assets/signatures.txt`
+("0xselector<TAB>text_sig" per line). SignatureDB seeds its SQLite
+database from this pack so offline analyses resolve real function names
+instead of `_function_0x…` placeholders (capability parity with the
+reference's shipped signatures.db asset,
+mythril/mythril/mythril_config.py:52-58).
+
+Usage: python tools/gen_signatures.py [source-dir ...]
+"""
+
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DEFAULT_DIRS = [
+    "/root/reference/tests/testdata/input_contracts",
+    "/root/reference/solidity_examples",
+]
+
+_FUNC_RE = re.compile(
+    r"\bfunction\s+([A-Za-z_$][A-Za-z0-9_$]*)\s*\(([^)]*)\)", re.S
+)
+
+_TYPE_ALIASES = {
+    "uint": "uint256",
+    "int": "int256",
+    "byte": "bytes1",
+}
+_ELEMENTARY = re.compile(
+    r"^(address|bool|string|bytes([0-9]+)?|u?int([0-9]+)?"
+    r"|u?fixed[0-9x]*)(\[[0-9]*\])*$"
+)
+
+
+def canonical_type(raw: str):
+    toks = raw.strip().split()
+    if not toks:
+        return None
+    base = toks[0]  # modifiers/names follow; "payable" etc. ignored
+    # arrays attach to the base token already ("uint[3]")
+    m = re.match(r"^([A-Za-z0-9]+)((\[[0-9]*\])*)$", base)
+    if not m:
+        return None
+    elem, arr = m.group(1), m.group(2)
+    elem = _TYPE_ALIASES.get(elem, elem)
+    out = elem + arr
+    if not _ELEMENTARY.match(out):
+        # user-defined types (contracts/enums/structs) aren't resolvable
+        # from source text alone — skip the whole signature
+        return None
+    return out
+
+
+def harvest(paths):
+    sigs = set()
+    for d in paths:
+        for f in sorted(Path(d).glob("**/*.sol")):
+            try:
+                text = f.read_text(errors="replace")
+            except OSError:
+                continue
+            # strip comments (best-effort)
+            text = re.sub(r"//[^\n]*", "", text)
+            text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+            for name, params in _FUNC_RE.findall(text):
+                types = []
+                ok = True
+                params = params.strip()
+                if params:
+                    for p in params.split(","):
+                        t = canonical_type(p)
+                        if t is None:
+                            ok = False
+                            break
+                        types.append(t)
+                if ok:
+                    sigs.add("{}({})".format(name, ",".join(types)))
+    return sigs
+
+
+def main():
+    from mythril_tpu.support.support_utils import sha3
+
+    dirs = sys.argv[1:] or [d for d in DEFAULT_DIRS
+                            if Path(d).is_dir()]
+    sigs = harvest(dirs)
+    out_path = (Path(__file__).resolve().parent.parent
+                / "mythril_tpu" / "support" / "assets"
+                / "signatures.txt")
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    lines = []
+    for sig in sorted(sigs):
+        selector = "0x" + sha3(sig.encode())[:4].hex()
+        lines.append(f"{selector}\t{sig}")
+    out_path.write_text("\n".join(lines) + "\n")
+    print(f"{len(lines)} signatures -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()
