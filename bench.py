@@ -120,7 +120,7 @@ def bench_host(code):
     return states / elapsed, states, elapsed, avg_len
 
 
-def build_symbolic_contract(k=10):
+def build_symbolic_contract(k=12):
     """Fork+SSTORE+SHA3 workload: k sequential symbolic branches (2^k
     feasible paths), an arithmetic arm + SSTORE per level, and a SHA3
     tail (which parks device-side — the bench deliberately includes the

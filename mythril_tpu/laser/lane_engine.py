@@ -399,10 +399,19 @@ def _unpack_logs(pulled):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _drain_reset(st: SymLaneState, prov_arr) -> SymLaneState:
+def _drain_reset(st: SymLaneState, prov_lanes, prov_slots,
+                 prov_oids) -> SymLaneState:
     """Remap provisional sids to resolved object ids (device-side — the
-    sid planes never leave the device) and reset the per-window logs."""
+    sid planes never leave the device) and reset the per-window logs.
+    The resolution table arrives as sparse (lane, slot, oid) triplets —
+    a dense (N, R) plane costs a megabyte of H2D per window on a
+    tunneled link. Unresolved slots hold int32 min so a leaked sid
+    fails loudly instead of aliasing a real record."""
     d_recs = st.dlog_op.shape[1]
+    prov_arr = jnp.full((st.pc.shape[0], d_recs),
+                        jnp.iinfo(jnp.int32).min, jnp.int32)
+    prov_arr = prov_arr.at[prov_lanes, prov_slots].set(
+        prov_oids, mode="drop")
 
     def remap(plane):
         negm = plane < 0
@@ -793,9 +802,9 @@ class LaneEngine:
         act = np.nonzero(
             (counts_h["dlog_count"] > 0) | (counts_h["pclog_count"] > 0)
         )[0].astype(np.int32)
+        empty = jnp.zeros(0, jnp.int32)
         if not len(act) and not nf:
-            return _drain_reset(st, jnp.asarray(np.full(
-                (n, d_recs), np.iinfo(np.int32).min, np.int32))), []
+            return _drain_reset(st, empty, empty, empty), []
         ka = _pow2_bucket(max(len(act), 1), n)
         act_pad = np.zeros(ka, np.int32)
         act_pad[: len(act)] = act
@@ -964,13 +973,16 @@ class LaneEngine:
 
         # 4. provisional sid rewrite (device-side: the sid planes never
         # leave the device) + per-window log reset, one dispatch
-        # unresolved slots map to int32 min (NOT -1, which is the
-        # legitimate provisional encoding of lane 0 slot 0) so a leaked
-        # sid fails loudly downstream instead of aliasing a real record
-        prov_arr = np.full((n, d_recs), np.iinfo(np.int32).min, np.int32)
-        for (lane, k), oid in prov.items():
-            prov_arr[lane, k] = oid
-        st = _drain_reset(st, jnp.asarray(prov_arr))
+        kp = _pow2_bucket(max(len(prov), 1), n * d_recs)
+        pl = np.full(kp, n, np.int32)  # padding -> mode=drop
+        ps = np.zeros(kp, np.int32)
+        po = np.zeros(kp, np.int32)
+        for i, ((lane, k), oid) in enumerate(prov.items()):
+            pl[i] = lane
+            ps[i] = k
+            po[i] = oid
+        st = _drain_reset(st, jnp.asarray(pl), jnp.asarray(ps),
+                          jnp.asarray(po))
         return st, dead
 
     # -- materialization -----------------------------------------------------

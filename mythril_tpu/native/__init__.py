@@ -75,6 +75,22 @@ def get_lib() -> ctypes.CDLL:
         lib.mtpu_sat_solve.restype = ctypes.c_int32
         lib.mtpu_sat_value.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.mtpu_sat_value.restype = ctypes.c_int32
+        try:
+            lib.mtpu_sat_assignment.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int8),
+                ctypes.c_int32,
+            ]
+            lib.mtpu_sat_assignment.restype = ctypes.c_int32
+            lib.mtpu_sat_values.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int8),
+            ]
+            lib.mtpu_sat_values.restype = None
+        except AttributeError:
+            pass  # stale library: per-literal value() still works
         lib.mtpu_sat_stats.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.mtpu_sat_stats.restype = ctypes.c_int64
         # blaster bindings are optional: a stale library without them
@@ -215,6 +231,33 @@ class SatSolver:
 
     def value(self, var: int) -> bool:
         return self._lib.mtpu_sat_value(self._h, var) == 1
+
+    def assignment_snapshot(self):
+        """The full current assignment as one int8 buffer (index 0 =
+        var 1): one native memcpy-style call instead of one FFI crossing
+        per model bit. None on a stale library without the symbol. The
+        buffer is reused (grow-only) — callers must not hold it across
+        solves."""
+        if not hasattr(self._lib, "mtpu_sat_assignment"):
+            return None
+        n = max(int(self._lib.mtpu_sat_stats(self._h, 3)),
+                self.nvars, 1)
+        buf = getattr(self, "_snap_buf", None)
+        if buf is None or len(buf) < n:
+            buf = self._snap_buf = (ctypes.c_int8 * (n * 2))()
+        self._lib.mtpu_sat_assignment(self._h, buf, len(buf))
+        return buf
+
+    def values_bulk(self, lits):
+        """Signed-literal truth values in one native call (1/0/-1 per
+        entry); None when the library predates the bulk symbol."""
+        if not hasattr(self._lib, "mtpu_sat_values"):
+            return None
+        n = len(lits)
+        arr = (ctypes.c_int32 * n)(*lits)
+        out = (ctypes.c_int8 * n)()
+        self._lib.mtpu_sat_values(self._h, arr, n, out)
+        return out
 
     def stats(self) -> dict:
         return {

@@ -588,6 +588,39 @@ int32_t mtpu_sat_value(void* sp, int32_t v) {
   int8_t a = s->assign[var];
   return a == T ? 1 : (a == F ? 0 : -1);
 }
+// bulk model values of signed DIMACS literals: out[i] = 1 lit true,
+// 0 lit false, -1 unassigned (one call instead of one per bit)
+void mtpu_sat_values(void* sp, const int32_t* lits, int32_t n,
+                     int8_t* out) {
+  Solver* s = (Solver*)sp;
+  for (int32_t i = 0; i < n; i++) {
+    int32_t l = lits[i];
+    Var var = (l > 0 ? l : -l) - 1;
+    if (var < 0 || var >= (int32_t)s->assign.size()) {
+      out[i] = -1;
+      continue;
+    }
+    int8_t a = s->assign[var];
+    if (a != T && a != F) {
+      out[i] = -1;
+    } else {
+      bool v = (a == T);
+      out[i] = (l > 0 ? v : !v) ? 1 : 0;
+    }
+  }
+}
+// dump the full assignment: out[i] = value of var i+1 (1/0/-1).
+// Returns the number of vars written (min(assign.size(), cap)).
+int32_t mtpu_sat_assignment(void* sp, int8_t* out, int32_t cap) {
+  Solver* s = (Solver*)sp;
+  int32_t n = (int32_t)s->assign.size();
+  if (n > cap) n = cap;
+  for (int32_t i = 0; i < n; i++) {
+    int8_t a = s->assign[i];
+    out[i] = a == T ? 1 : (a == F ? 0 : -1);
+  }
+  return n;
+}
 int64_t mtpu_sat_stats(void* sp, int32_t which) {
   Solver* s = (Solver*)sp;
   switch (which) {
@@ -597,6 +630,8 @@ int64_t mtpu_sat_stats(void* sp, int32_t which) {
       return s->propagations;
     case 2:
       return s->decisions;
+    case 3:
+      return (int64_t)s->assign.size();
     default:
       return 0;
   }

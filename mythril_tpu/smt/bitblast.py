@@ -653,6 +653,7 @@ class NativeBlaster:
     def _exec(self, tape):
         import array
 
+        self._snap = None  # any new tape invalidates a model snapshot
         if not tape:
             self._pending_bv.clear()
             self._pending_bool.clear()
@@ -725,6 +726,13 @@ class NativeBlaster:
         tape.append(t.tid)
         self._exec(tape)
 
+    def snapshot_model(self) -> None:
+        """Capture the full SAT assignment in one native call; later
+        model_value calls read the snapshot instead of crossing the FFI
+        per word. The extractor clears it under try/finally, and _exec
+        defensively invalidates on any new tape execution."""
+        self._snap = self.sat.assignment_snapshot()
+
     def model_value(self, t) -> int:
         if t.is_bool:
             if t.tid not in self._bool:
@@ -732,9 +740,29 @@ class NativeBlaster:
             return 1 if self._lit_val(self.bool_lit(t)) else 0
         if t.tid not in self._bv:
             return 0
+        lits = self.bits(t)
+        snap = getattr(self, "_snap", None)
         v = 0
-        for i, l in enumerate(self.bits(t)):
-            if self._lit_val(l):
+        if snap is not None:
+            ns = len(snap)
+            for i, l in enumerate(lits):
+                var = (l if l > 0 else -l) - 1
+                va = snap[var] if 0 <= var < ns else -1
+                # unassigned (-1) mirrors _lit_val: False for positive
+                # literals, True for negated ones
+                if (va == 1) if l > 0 else (va != 1):
+                    v |= 1 << i
+            return v
+        vals = self.sat.values_bulk(lits)  # one native call per word
+        if vals is None:  # stale library without the bulk symbol
+            for i, l in enumerate(lits):
+                if self._lit_val(l):
+                    v |= 1 << i
+            return v
+        for i in range(len(lits)):
+            # C reports lit truth when assigned, -1 when not; unassigned
+            # negated literals count as true (_lit_val parity)
+            if vals[i] == 1 or (vals[i] == -1 and lits[i] < 0):
                 v |= 1 << i
         return v
 

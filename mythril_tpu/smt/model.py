@@ -46,13 +46,17 @@ class Model:
                 last_err = e
                 continue
         if model_completion and self.raw:
-            # merge all buckets, then complete with defaults
-            merged = self.raw[0].env(complete=True)
-            for m in self.raw[1:]:
-                merged.bv.update(m.bv)
-                merged.bv.update(m.bools)
-                merged.arrays.update(m.arrays)
-                merged.funcs.update(m.funcs)
+            # merge all buckets into a FRESH env, then complete with
+            # defaults — ModelData.env() is cached and must never be
+            # mutated in place
+            bv, arrays, funcs = {}, {}, {}
+            for m in self.raw:
+                bv.update(m.bv)
+                bv.update(m.bools)
+                arrays.update(m.arrays)
+                funcs.update(m.funcs)
+            merged = T.EvalEnv(bv=bv, arrays=arrays, funcs=funcs,
+                               complete=True)
             return _wrap(t, T.eval_term(t, merged))
         if model_completion:
             return _wrap(t, T.eval_term(t, T.EvalEnv(complete=True)))

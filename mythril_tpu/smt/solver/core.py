@@ -39,13 +39,33 @@ class ModelData:
         self.funcs: Dict[str, Dict[tuple, int]] = {}
 
     def env(self, complete: bool = True) -> "T.EvalEnv":
+        # extraction never mutates a ModelData after check(); cache the
+        # merged env — quick-sat re-evaluates cached models constantly
+        cached = getattr(self, "_env_cache", None)
+        if cached is not None and cached[0] == complete:
+            return cached[1]
         bv = dict(self.bv)
         bv.update(self.bools)
-        return T.EvalEnv(bv=bv, arrays=self.arrays, funcs=self.funcs,
-                         complete=complete)
+        env = T.EvalEnv(bv=bv, arrays=self.arrays, funcs=self.funcs,
+                        complete=complete)
+        self._env_cache = (complete, env)
+        return env
+
+    #: persistent-memo size bound: STORE nodes memoize dict snapshots,
+    #: so an unbounded memo grows quadratically on deep storage chains
+    _MEMO_CAP = 100_000
 
     def eval_term(self, t: "T.Term", complete: bool = True):
-        return T.eval_term(t, self.env(complete=complete))
+        # persistent per-model memo: terms are hash-consed process-wide
+        # and the assignment is frozen, so subterm values computed for
+        # one quick-sat probe stay valid for every later probe
+        memos = getattr(self, "_eval_memos", None)
+        if memos is None:
+            memos = self._eval_memos = {}
+        memo = memos.setdefault(complete, {})
+        if len(memo) > self._MEMO_CAP:
+            memo.clear()
+        return T.eval_term(t, self.env(complete=complete), memo)
 
 
 def _flatten(assertions: List["T.Term"]) -> List["T.Term"]:
@@ -606,6 +626,21 @@ def _query_scope(work, expanded):
 def _extract_model(blaster, sat, subs, select_map, apply_map,
                    scope=None) -> ModelData:
     md = ModelData()
+    if hasattr(blaster, "snapshot_model"):
+        # one native call for the whole assignment instead of one FFI
+        # crossing per extracted word; _extract_model_inner runs under
+        # try/finally so a raising extraction can't leak a stale snap
+        blaster.snapshot_model()
+    try:
+        return _extract_model_inner(md, blaster, sat, subs, select_map,
+                                    apply_map, scope)
+    finally:
+        if hasattr(blaster, "snapshot_model"):
+            blaster._snap = None
+
+
+def _extract_model_inner(md, blaster, sat, subs, select_map, apply_map,
+                         scope):
     arr_names = func_names = ack_tids = None
     if scope is not None:
         scope_vars, arr_names, func_names = scope

@@ -54,13 +54,28 @@ class LRUCache:
 
 class ModelCache:
     """Caches recent models; quick-sat re-evaluates a constraint under cached
-    models before invoking the solver (reference support_utils.py:55-68)."""
+    models before invoking the solver (reference support_utils.py:55-68).
+
+    The scan width adapts to the observed hit rate: on miss-heavy
+    workloads (fork-dense path sweeps where every query has a distinct
+    path condition) re-evaluating 100 models per query costs far more
+    than the solve it tries to avoid, so the scan shrinks toward a few
+    most-recent models and recovers geometrically on any hit."""
+
+    MAX_SCAN = 100
+    MIN_SCAN = 4
 
     def __init__(self):
         self.model_cache = LRUCache(size=100)
+        self._scan = self.MAX_SCAN
+        self._misses = 0
 
     def check_quick_sat(self, constraint_term) -> object:
+        scanned = 0
         for model in reversed(self.model_cache.lru_cache.keys()):
+            if scanned >= self._scan:
+                break
+            scanned += 1
             try:
                 result = model.raw[0].eval_term(constraint_term,
                                                 complete=False)
@@ -68,7 +83,13 @@ class ModelCache:
                 continue
             if result is True:
                 self.model_cache.put(model, 1)
+                self._misses = 0
+                self._scan = min(self._scan * 2, self.MAX_SCAN)
                 return model
+        self._misses += 1
+        if self._misses >= 8:
+            self._misses = 0
+            self._scan = max(self._scan // 2, self.MIN_SCAN)
         return None
 
     def put(self, model, weight) -> None:
