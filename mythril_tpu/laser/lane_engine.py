@@ -212,33 +212,40 @@ N_MISC = 4  # dlog_count, pclog_count, status, steps
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _window_prologue(st: SymLaneState, idx, i32p, u32p, u8p, fs,
-                     fcount) -> SymLaneState:
+def _window_prologue(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
+                     stack_s, mem_v, mem_k, fs, fcount) -> SymLaneState:
     """Per-window device prologue in ONE dispatch: reset + seed the
-    rows in idx (padded entries hold n -> dropped) from three packed
-    host arrays, and refresh the free-slot stack."""
+    rows in idx (padded entries hold n -> dropped) from packed host
+    arrays, and refresh the free-slot stack. Mid-path states (host
+    spill/refill, ROADMAP mid-state re-seeding) arrive with nonzero
+    pc/sp/stack/memory columns."""
     k = idx.shape[0]
     n_env = st.env.shape[1]
 
     def zero(plane):
         return plane.at[idx].set(0, mode="drop")
 
-    # i32 pack: [sbase, cd_size, cd_sym, cd_size_sid, env_sid…]
+    # i32 pack: [sbase, cd_size, cd_sym, cd_size_sid, pc, sp, msize,
+    #            env_sid…]
     sbase, cd_size, cd_sym, cd_size_sid = (
         i32p[:, 0], i32p[:, 1], i32p[:, 2], i32p[:, 3])
-    env_sid = i32p[:, 4:4 + n_env]
+    pc, sp, msize = i32p[:, 4], i32p[:, 5], i32p[:, 6]
+    env_sid = i32p[:, 7:7 + n_env]
     # u32 pack: [gas_limit, env limbs…]
     gas_limit = u32p[:, 0]
     env = u32p[:, 1:].reshape(k, n_env, bv256.NLIMBS)
 
     return st._replace(
-        pc=zero(st.pc),
-        sp=zero(st.sp),
+        pc=st.pc.at[idx].set(pc, mode="drop"),
+        sp=st.sp.at[idx].set(sp, mode="drop"),
         depth=zero(st.depth),
-        ssid=zero(st.ssid),
-        memory=zero(st.memory),
-        mkind=zero(st.mkind),
-        msize=zero(st.msize),
+        ssid=st.ssid.at[idx].set(stack_s, mode="drop"),
+        stack=st.stack.at[idx].set(
+            stack_v.reshape(k, st.stack.shape[1], bv256.NLIMBS),
+            mode="drop"),
+        memory=st.memory.at[idx].set(mem_v, mode="drop"),
+        mkind=st.mkind.at[idx].set(mem_k, mode="drop"),
+        msize=st.msize.at[idx].set(msize, mode="drop"),
         mlog_count=zero(st.mlog_count),
         sval_sid=zero(st.sval_sid),
         s_written=zero(st.s_written),
@@ -431,6 +438,45 @@ def _drain_reset(st: SymLaneState, prov_lanes, prov_slots,
 
 def _limbs_int(limbs) -> int:
     return bv256.limbs_to_int(np.asarray(limbs))
+
+
+def lane_seedable(gs, stack_depth: int = 64,
+                  memory_bytes: int = 4096,
+                  exec_table=None) -> bool:
+    """True when the lane engine can seed this state: tx-entry states
+    and mid-path states with device-representable stack/memory (the
+    host spill/refill path — over-capacity forks park to the host and
+    their descendants re-enter the device here). Mid-path limits:
+    every stack item is an int/term, memory bytes are concrete, and the
+    state advanced past the instruction it parked at."""
+    from .transaction import MessageCallTransaction
+
+    ms = gs.mstate
+    storage = gs.environment.active_account.storage
+    ilist = gs.environment.code.instruction_list
+    if (
+        gs.environment.static
+        or ms.subroutine_stack
+        or not isinstance(gs.current_transaction, MessageCallTransaction)
+        or (storage.dynld and storage.dynld.active)
+        or getattr(gs, "_lane_parked_pc", None) == ms.pc
+        or ms.pc >= len(ilist)
+        or len(ms.stack) > stack_depth
+        or int(ms.memory_size) > memory_bytes
+    ):
+        return False
+    table = symstep.SYM_EXECUTABLE if exec_table is None else exec_table
+    op_byte = _OPB.get(ilist[ms.pc]["opcode"])
+    if op_byte is None or not table[op_byte]:
+        return False  # would park on the first device step anyway
+    for key, val in ms.memory._memory.items():
+        if not isinstance(key, int):
+            return False
+        if isinstance(val, int):
+            continue
+        if not (isinstance(val, BitVec) and val.value is not None):
+            return False
+    return True
 
 
 def code_to_bytes(code_obj) -> Optional[bytes]:
@@ -655,11 +701,43 @@ class LaneEngine:
             else:
                 cd_size_sid = self.objects.add(size)
 
+        # mid-path seeds (host spill/refill): device pc is a byte
+        # address; stack objects become sids; memory must be concrete
+        # bytes (ints or concrete 8-bit terms — eligibility checked by
+        # svm.lane_seedable)
+        n_depth = self.lane_kwargs.get("stack_depth", 64)
+        mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
+        byte_pc = 0
+        if ms.pc:
+            byte_pc = ilist[ms.pc]["address"]
+        stack_v = np.zeros((n_depth, bv256.NLIMBS), np.uint32)
+        stack_s = np.zeros(n_depth, np.int32)
+        for i, item in enumerate(ms.stack):
+            if isinstance(item, int):
+                stack_v[i] = bv256.int_to_limbs(item)
+            elif isinstance(item, BitVec) and item.value is not None:
+                stack_v[i] = bv256.int_to_limbs(item.value)
+            else:
+                if isinstance(item, Bool):
+                    item = If(item, _bv_val(1), _bv_val(0))
+                stack_s[i] = self.objects.add(item)
+        mem_v = np.zeros(mem_cap, np.uint8)
+        mem_k = np.zeros(mem_cap, np.uint8)
+        for key, val in ms.memory._memory.items():
+            if isinstance(val, int):
+                mem_v[key] = val & 0xFF
+                mem_k[key] = symstep.KIND_BYTE_INT
+            else:  # concrete 8-bit term (eligibility guarantees)
+                mem_v[key] = val.value & 0xFF
+                mem_k[key] = symstep.KIND_CONC_WORD
+
         return ctx, dict(
             sbase=0 if virgin_zero else 1,
             calldata=cd_buf, cd_size=cd_size, cd_sym=cd_sym,
             cd_size_sid=cd_size_sid, env=env_vals, env_sid=env_sids,
             gas_limit=dev_limit,
+            pc=byte_pc, sp=len(ms.stack), msize=int(ms.memory_size),
+            stack_v=stack_v, stack_s=stack_s, mem_v=mem_v, mem_k=mem_k,
         )
 
     def seed_all(self, st: SymLaneState, entries,
@@ -677,26 +755,41 @@ class LaneEngine:
             ctxs[lane] = ctx
             lanes.append(lane)
             specs.append(spec)
+        n_depth = self.lane_kwargs.get("stack_depth", 64)
+        mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
         k = _pow2_bucket(max(len(lanes), 1), n)
         idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
         idx[: len(lanes)] = lanes
-        i32p = np.zeros((k, 4 + n_env), np.int32)
+        i32p = np.zeros((k, 7 + n_env), np.int32)
         u32p = np.zeros((k, 1 + n_env * bv256.NLIMBS), np.uint32)
         u8p = np.zeros((k, cap), np.uint8)
+        stack_v = np.zeros((k, n_depth * bv256.NLIMBS), np.uint32)
+        stack_s = np.zeros((k, n_depth), np.int32)
+        mem_v = np.zeros((k, mem_cap), np.uint8)
+        mem_k = np.zeros((k, mem_cap), np.uint8)
         for i, s in enumerate(specs):
             i32p[i, 0] = s["sbase"]
             i32p[i, 1] = s["cd_size"]
             i32p[i, 2] = s["cd_sym"]
             i32p[i, 3] = s["cd_size_sid"]
-            i32p[i, 4:] = s["env_sid"]
+            i32p[i, 4] = s["pc"]
+            i32p[i, 5] = s["sp"]
+            i32p[i, 6] = s["msize"]
+            i32p[i, 7:] = s["env_sid"]
             u32p[i, 0] = s["gas_limit"]
             u32p[i, 1:] = s["env"].reshape(-1)
             u8p[i] = s["calldata"]
+            stack_v[i] = s["stack_v"].reshape(-1)
+            stack_s[i] = s["stack_s"]
+            mem_v[i] = s["mem_v"]
+            mem_k[i] = s["mem_k"]
         fs = np.zeros(n, np.int32)
         fs[: len(free)] = free
         st = _window_prologue(
             st, jnp.asarray(idx), jnp.asarray(i32p), jnp.asarray(u32p),
-            jnp.asarray(u8p), jnp.asarray(fs),
+            jnp.asarray(u8p), jnp.asarray(stack_v),
+            jnp.asarray(stack_s), jnp.asarray(mem_v),
+            jnp.asarray(mem_k), jnp.asarray(fs),
             jnp.asarray(np.int32(len(free))),
         )
         self.stats["seeded"] += len(entries)
@@ -1012,7 +1105,11 @@ class LaneEngine:
         ms.min_gas_used = ctx.gas0_min + int(st_host["min_gas"][lane])
         ms.max_gas_used = ctx.gas0_max + int(st_host["max_gas"][lane])
 
-        # stack
+        # stack: the device planes hold the COMPLETE current stack
+        # (mid-path re-seeds arrive with the template's entries already
+        # on device) — rebuild from scratch, never append to the
+        # template's copy
+        del ms.stack[:]
         sp = int(st_host["sp"][lane])
         for s in range(sp):
             sid = int(st_host["ssid"][lane, s])
@@ -1025,7 +1122,11 @@ class LaneEngine:
         # memory: reproduce the byte-level representation the Memory
         # class would hold after the same writes — MSTORE8 bytes as
         # ints, concrete-word bytes as 8-bit const terms, symbolic-word
-        # bytes as Extract slices (state/memory.py:61-88)
+        # bytes as Extract slices (state/memory.py:61-88). Like the
+        # stack, the device planes are the complete state: reset the
+        # template's copy before rebuilding
+        ms.memory._memory.clear()
+        ms.memory._msize = 0
         msize = int(st_host["msize"][lane])
         if msize:
             ms.memory.extend(msize)
@@ -1092,6 +1193,13 @@ class LaneEngine:
             for ad in self.adapters:
                 plist = ctx.promos.get(id(ad), ())
                 ad.attach(gs, [a for (_, a) in plist], last_jump)
+
+        # spill/refill marker: the state parked AT this instruction
+        # because the device could not execute it — it must take at
+        # least one host step before becoming re-seedable (the marker
+        # does not survive GlobalState.__copy__, so the post-step
+        # states are eligible again)
+        gs._lane_parked_pc = ms.pc
 
         self.stats["parked"] += 1
         return gs

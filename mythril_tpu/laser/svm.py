@@ -284,33 +284,15 @@ class LaserEVM:
         those, so hooks/detectors/transaction semantics are unchanged
         for everything host-executed."""
         try:
-            from .lane_engine import LaneEngine, code_to_bytes
+            from .lane_engine import (
+                LaneEngine,
+                code_to_bytes,
+                lane_seedable,
+            )
         except Exception as e:  # jax/device init failure -> host path
             log.warning("lane engine unavailable (%s)", e)
             return
-        from .transaction import MessageCallTransaction
 
-        eligible, rest = [], []
-        for gs in self.work_list:
-            ms = gs.mstate
-            storage = gs.environment.active_account.storage
-            code = code_to_bytes(gs.environment.code)
-            if (
-                code
-                and ms.pc == 0
-                and len(ms.stack) == 0
-                and ms.memory_size == 0
-                and len(ms.subroutine_stack) == 0
-                and not gs.environment.static
-                and isinstance(gs.current_transaction,
-                               MessageCallTransaction)
-                and not (storage.dynld and storage.dynld.active)
-            ):
-                eligible.append((code, gs))
-            else:
-                rest.append(gs)
-        if not eligible:
-            return
         # every opcode with a registered hook must park device-side so
         # the hook fires on the host — unless the hook's module has a
         # lane adapter (analysis/module/lane_adapters.py) that lifts it:
@@ -352,6 +334,23 @@ class LaserEVM:
             # the device cannot fork, so batching buys nothing
             log.info("lane engine idle: JUMPI hooked without an adapter")
             return
+        from ..ops import symstep as _symstep
+
+        table = _symstep.SYM_EXECUTABLE.copy()
+        from .lane_engine import _OPB as _opb
+
+        for name in blocked:
+            if name in _opb:
+                table[_opb[name]] = False
+        eligible, rest = [], []
+        for gs in self.work_list:
+            code = code_to_bytes(gs.environment.code)
+            if code and lane_seedable(gs, exec_table=table):
+                eligible.append((code, gs))
+            else:
+                rest.append(gs)
+        if not eligible:
+            return
         groups: Dict[bytes, List[GlobalState]] = {}
         for code, gs in eligible:
             groups.setdefault(code, []).append(gs)
@@ -386,6 +385,7 @@ class LaserEVM:
         if args.tpu_lanes and not create and not track_gas:
             self._lane_engine_sweep()
 
+        iter_since_sweep = 0
         for global_state in self.strategy:
             if create and self._check_create_termination():
                 log.debug("Hit create timeout, returning.")
@@ -405,12 +405,23 @@ class LaserEVM:
                 and len(new_states) > 1
                 and random.uniform(0, 1) < args.pruning_factor
             ):
-                new_states = [
-                    state
-                    for state in new_states
-                    if state.world_state.constraints.is_possible()
-                ]
+                from ..models.pruner import prune_feasible_states
+
+                new_states = prune_feasible_states(new_states)
             self.manage_cfg(op_code, new_states)
+            # spill/refill: mid-path states that became device-seedable
+            # again (host executed past their park site) re-enter the
+            # lane engine periodically
+            iter_since_sweep += 1
+            if (
+                args.tpu_lanes
+                and not create
+                and not track_gas
+                and iter_since_sweep >= 512
+                and len(self.work_list) >= 16
+            ):
+                iter_since_sweep = 0
+                self._lane_engine_sweep()
             if new_states:
                 self.work_list += new_states
             elif track_gas:

@@ -19,28 +19,52 @@ log = logging.getLogger(__name__)
 # below this many states the host loop beats device dispatch overhead
 DEVICE_BATCH_THRESHOLD = 8
 
-# latched after the first hard device failure: a broken device path would
-# otherwise pay a full DAG linearization before every host fallback
-_device_disabled = False
+# bounded backoff instead of a permanent latch: one transient device
+# hiccup must not silently degrade every later contract in a corpus run
+# to host screening. Each failure doubles the number of calls skipped
+# before the next retry (capped); a success resets the backoff.
+_device_failures = 0
+_device_skip = 0
+_MAX_SKIP = 256
+
+
+def _device_should_try() -> bool:
+    global _device_skip
+    if _device_skip > 0:
+        _device_skip -= 1
+        return False
+    return True
+
+
+def _device_failed(e: Exception) -> None:
+    global _device_failures, _device_skip
+    _device_failures += 1
+    _device_skip = min(2 ** _device_failures, _MAX_SKIP)
+    log.warning(
+        "device interval screening failed (%s); falling back to host "
+        "screening, retrying the device in %d calls", e, _device_skip,
+    )
+
+
+def _device_succeeded() -> None:
+    global _device_failures
+    _device_failures = 0
 
 
 def prefilter_world_states(open_states: List) -> List:
     """Drop world states with an interval-infeasible constraint. Sound:
     only provably-unsat states are removed."""
-    global _device_disabled
     if (
         args.tpu_lanes
-        and not _device_disabled
         and len(open_states) >= DEVICE_BATCH_THRESHOLD
+        and _device_should_try()
     ):
         try:
-            return _prefilter_device(open_states)
-        except Exception as e:  # fall back to host screening permanently
-            _device_disabled = True
-            log.warning(
-                "device interval screening failed (%s); falling back to "
-                "host screening for the rest of this run", e,
-            )
+            out = _prefilter_device(open_states)
+            _device_succeeded()
+            return out
+        except Exception as e:  # bounded backoff, then retry
+            _device_failed(e)
     out = []
     dropped = 0
     for ws in open_states:
@@ -56,6 +80,58 @@ def prefilter_world_states(open_states: List) -> List:
     if dropped:
         log.info("interval pre-filter dropped %d open states", dropped)
     return out
+
+
+def _screen_interval(items: List, get_constraints) -> List:
+    """Shared interval screen: device-batched when large enough (with
+    the failure backoff), host transfer functions otherwise. Sound —
+    only provably-unsat items are dropped."""
+    if (
+        args.tpu_lanes
+        and len(items) >= DEVICE_BATCH_THRESHOLD
+        and _device_should_try()
+    ):
+        try:
+            from ..ops.intervals import prefilter_feasible
+
+            keep = prefilter_feasible(
+                [[c.raw for c in get_constraints(it)] for it in items]
+            )
+            out = [it for it, k in zip(items, keep) if k]
+            _device_succeeded()
+        except Exception as e:
+            _device_failed(e)
+            out = items
+    else:
+        out = []
+        for it in items:
+            try:
+                if state_infeasible(list(get_constraints(it))):
+                    continue
+            except Exception:
+                pass
+            out.append(it)
+    dropped = len(items) - len(out)
+    if dropped:
+        log.info("interval pre-filter dropped %d/%d", dropped,
+                 len(items))
+    return out
+
+
+def prune_feasible_states(states: List) -> List:
+    """Per-fork feasibility pruning (svm pruning_factor path,
+    reference svm.py:319-326): screen the batch through the interval
+    domain first and only the survivors pay a solver `is_possible`
+    check (which keeps the reference's timeout-means-possible
+    semantics)."""
+    if not states:
+        return states
+    survivors = _screen_interval(
+        states, lambda s: s.world_state.constraints)
+    return [
+        s for s in survivors
+        if s.world_state.constraints.is_possible()
+    ]
 
 
 def _prefilter_device(open_states: List) -> List:

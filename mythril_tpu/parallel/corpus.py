@@ -1,0 +1,189 @@
+"""Multi-host corpus mode: contract-shard scheduling over DCN.
+
+The reference's only multi-machine story is "run 30 `myth` processes"
+(/root/reference/tests/integration_tests/parallel_test.py:8-16). The
+TPU-native equivalent (SURVEY.md §2.10, distributed-backend row) is a
+jax.distributed process group: every host joins one coordinator, takes
+a deterministic disjoint shard of the contract corpus, analyzes it with
+its own engine (host interpreter or lane engine over its local chips),
+and the group barriers on JAX collectives — the same transport that
+would carry cross-host lane traffic — before rank 0 merges the shard
+reports.
+
+Run one process per host:
+
+    python -m mythril_tpu.parallel.corpus \
+        --coordinator HOST:PORT --num-processes N --process-id I \
+        --out-dir DIR file1.sol.o file2.sol.o ...
+
+CPU-testable with local processes (tests/test_corpus_distributed.py
+drives two coordinator-connected processes on a virtual CPU backend).
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Join the jax.distributed process group (idempotent); returns this
+    process's rank. With no coordinator configured, runs standalone as
+    rank 0 of 1."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("MTPU_COORDINATOR")
+    if coordinator is None:
+        return 0
+    if num_processes is None:
+        num_processes = int(os.environ["MTPU_NUM_PROCESSES"])
+    if process_id is None:
+        process_id = int(os.environ["MTPU_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return process_id
+
+
+def shard_corpus(paths: Sequence[str], process_id: int,
+                 num_processes: int) -> List[str]:
+    """Deterministic disjoint round-robin shard (sorted order, so every
+    rank computes the same assignment without communicating)."""
+    ordered = sorted(paths)
+    return [p for i, p in enumerate(ordered)
+            if i % num_processes == process_id]
+
+
+def _barrier(name: str) -> None:
+    """Group-wide barrier riding the DCN collective transport."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def default_analyze(path: str, timeout: int = 60,
+                    tpu_lanes: int = 0) -> dict:
+    """One contract end to end with the full default detector set."""
+    from types import SimpleNamespace
+
+    from ..orchestration.mythril_analyzer import MythrilAnalyzer
+    from ..orchestration.mythril_disassembler import MythrilDisassembler
+
+    disassembler = MythrilDisassembler(eth=None)
+    code = Path(path).read_text().strip()
+    address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
+    cmd_args = SimpleNamespace(
+        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=None, unconstrained_storage=False,
+        parallel_solving=False, call_depth_limit=3,
+        disable_dependency_pruning=False, custom_modules_directory="",
+        solver_log=None, transaction_sequences=None,
+        tpu_lanes=tpu_lanes,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+    report = analyzer.fire_lasers(modules=None, transaction_count=2)
+    issues = report.sorted_issues()
+    return {
+        "contract": Path(path).name,
+        "issues": len(issues),
+        "swc": sorted({i["swc-id"] for i in issues}),
+    }
+
+
+def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
+               num_processes: int,
+               analyze: Callable[[str], dict] = default_analyze) -> dict:
+    """Analyze this rank's shard, write shard_<rank>.json, barrier, and
+    (rank 0) merge every shard into corpus_report.json."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    shard = shard_corpus(paths, process_id, num_processes)
+    results = []
+    t0 = time.perf_counter()
+    for path in shard:
+        try:
+            results.append(analyze(path))
+        except Exception as e:  # keep sweeping — reference parity with
+            # the analyzer's per-contract exception capture
+            log.warning("analysis of %s failed: %s", path, e)
+            results.append(
+                {"contract": Path(path).name, "error": type(e).__name__}
+            )
+    shard_report = {
+        "process_id": process_id,
+        "num_processes": num_processes,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "results": results,
+    }
+    (out / f"shard_{process_id}.json").write_text(
+        json.dumps(shard_report))
+    _barrier("mythril_tpu_corpus_done")
+    if process_id != 0:
+        return shard_report
+    merged = {"num_processes": num_processes, "contracts": [],
+              "total_issues": 0, "errors": 0, "shards": []}
+    for rank in range(num_processes):
+        shard_file = out / f"shard_{rank}.json"
+        if not shard_file.exists():
+            raise FileNotFoundError(
+                f"{shard_file} missing after the corpus barrier: "
+                "--out-dir must be a filesystem shared by every host "
+                "(NFS/GCS mount) — each rank writes its shard report "
+                "there for rank 0 to merge"
+            )
+        data = json.loads(shard_file.read_text())
+        merged["shards"].append(
+            {"process_id": rank, "wall_s": data["wall_s"],
+             "n": len(data["results"])})
+        for r in data["results"]:
+            merged["contracts"].append(r)
+            merged["total_issues"] += r.get("issues", 0)
+            merged["errors"] += 1 if "error" in r else 0
+    merged["contracts"].sort(key=lambda r: r["contract"])
+    (out / "corpus_report.json").write_text(json.dumps(merged))
+    return merged
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--coordinator", default=None,
+                        help="HOST:PORT of rank 0 (omit = standalone)")
+    parser.add_argument("--num-processes", type=int, default=None,
+                        help="defaults to $MTPU_NUM_PROCESSES or 1")
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="defaults to $MTPU_PROCESS_ID or 0")
+    parser.add_argument("--out-dir", required=True)
+    parser.add_argument("--timeout", type=int, default=60)
+    parser.add_argument("--tpu-lanes", type=int, default=0)
+    parser.add_argument("files", nargs="+")
+    args = parser.parse_args(argv)
+
+    rank = init_distributed(args.coordinator, args.num_processes,
+                            args.process_id)
+    num_processes = args.num_processes or int(
+        os.environ.get("MTPU_NUM_PROCESSES", 1))
+    report = run_corpus(
+        args.files, args.out_dir, rank, num_processes,
+        analyze=lambda p: default_analyze(
+            p, timeout=args.timeout, tpu_lanes=args.tpu_lanes),
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,10 +52,18 @@ def run(path: pathlib.Path, lanes: int, timeout=900):
 
 
 def canon(report):
+    """Comparable issue list: identity fields only. tx_sequence model
+    values (initial balances, which of several valid selectors reaches
+    a shared site, …) are solver-choice-dependent and may legitimately
+    differ between engines whose query order differs; exact exploit
+    calldata is pinned separately by the oracle fixtures
+    (tests/test_analysis_accuracy.py, test_lane_adapter_parity.py)."""
     issues = []
     for i in report.get("issues") or []:
         i = dict(i)
         i.pop("discoveryTime", None)
+        seq = i.pop("tx_sequence", None)
+        i["has_tx_sequence"] = bool(seq and seq.get("steps"))
         issues.append(i)
     return sorted(issues, key=lambda i: json.dumps(i, sort_keys=True))
 

@@ -1,0 +1,72 @@
+"""Multi-host corpus mode (SURVEY.md §2.10 DCN row): two coordinator-
+connected jax.distributed processes analyze disjoint corpus shards and
+rank 0 merges the reports. Runs on the CPU backend — the same
+jax.distributed + collective-barrier path a real multi-host deployment
+uses over DCN (reference analog: 30 parallel CLI processes,
+/root/reference/tests/integration_tests/parallel_test.py:8-16)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.parallel.corpus import shard_corpus
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+FIXTURES = ["suicide.sol.o", "origin.sol.o", "returnvalue.sol.o",
+            "nonascii.sol.o"]
+
+
+def test_shard_disjoint_and_complete():
+    paths = [f"c{i}.o" for i in range(7)]
+    shards = [shard_corpus(paths, i, 3) for i in range(3)]
+    flat = [p for s in shards for p in s]
+    assert sorted(flat) == sorted(paths)
+    assert len(set(flat)) == len(paths)
+    # deterministic regardless of input order
+    assert shard_corpus(list(reversed(paths)), 1, 3) == shards[1]
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+def test_two_process_corpus(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    files = [str(INPUTS / f) for f in FIXTURES]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+             "--coordinator", coordinator,
+             "--num-processes", "2", "--process-id", str(rank),
+             "--out-dir", str(tmp_path), "--timeout", "60"] + files,
+            cwd="/root/repo", env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+
+    merged = json.loads((tmp_path / "corpus_report.json").read_text())
+    assert merged["num_processes"] == 2
+    assert [c["contract"] for c in merged["contracts"]] == sorted(FIXTURES)
+    assert merged["errors"] == 0
+    # both ranks did real, disjoint work
+    assert [s["n"] for s in merged["shards"]] == [2, 2]
+    shard0 = json.loads((tmp_path / "shard_0.json").read_text())
+    shard1 = json.loads((tmp_path / "shard_1.json").read_text())
+    names0 = {r["contract"] for r in shard0["results"]}
+    names1 = {r["contract"] for r in shard1["results"]}
+    assert not (names0 & names1)
+    # expected findings survive the merge (suicide fixture -> SWC-106)
+    by_name = {c["contract"]: c for c in merged["contracts"]}
+    assert "106" in by_name["suicide.sol.o"]["swc"]
+    assert by_name["origin.sol.o"]["issues"] >= 1

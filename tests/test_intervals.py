@@ -209,3 +209,91 @@ def test_wide_constants_are_topped_not_truncated():
     ]
     dev = list(prefilter_feasible(sets))
     assert bool(dev[0]) and bool(dev[1])
+
+
+def test_device_failure_backoff_and_recovery(monkeypatch):
+    """A device failure must not latch screening off permanently: the
+    pruner backs off a bounded number of calls, retries, and a success
+    resets the backoff (VERDICT r1: one transient hiccup silently
+    degraded every later contract to host screening)."""
+    from mythril_tpu.models import pruner
+    from mythril_tpu.support.support_args import args
+
+    class FakeWS:
+        def __init__(self, constraints):
+            self.constraints = constraints
+
+    x = sym("x_backoff")
+    states = [FakeWS([UGT(x, BV(10))]) for _ in range(16)]
+
+    calls = {"n": 0, "fail": True}
+
+    def fake_device(open_states):
+        calls["n"] += 1
+        if calls["fail"]:
+            raise RuntimeError("transient device hiccup")
+        return list(open_states)
+
+    monkeypatch.setattr(pruner, "_prefilter_device", fake_device)
+    monkeypatch.setattr(pruner, "_device_failures", 0)
+    monkeypatch.setattr(pruner, "_device_skip", 0)
+    monkeypatch.setattr(args, "tpu_lanes", 64)
+    try:
+        out = pruner.prefilter_world_states(states)
+        assert len(out) == len(states)  # host fallback kept everything
+        assert calls["n"] == 1
+        # backoff: the next call skips the device...
+        pruner.prefilter_world_states(states)
+        assert calls["n"] == 1
+        # ...then retries; let it succeed and verify the reset
+        calls["fail"] = False
+        for _ in range(8):
+            pruner.prefilter_world_states(states)
+        assert calls["n"] >= 2
+        assert pruner._device_failures == 0
+        n_before = calls["n"]
+        pruner.prefilter_world_states(states)
+        assert calls["n"] == n_before + 1  # no skip after success
+    finally:
+        args.tpu_lanes = 0
+        pruner._device_failures = 0
+        pruner._device_skip = 0
+
+
+def test_prune_feasible_states_batched(monkeypatch):
+    """prune_feasible_states: interval screen (device when batched)
+    drops provably-unsat forks; survivors keep is_possible semantics."""
+    from mythril_tpu.models import pruner
+    from mythril_tpu.support.support_args import args
+
+    class FakeConstraints(list):
+        def is_possible(self):
+            return True
+
+    class FakeWS:
+        def __init__(self, constraints):
+            self.constraints = FakeConstraints(constraints)
+
+    class FakeGS:
+        def __init__(self, constraints):
+            self.world_state = FakeWS(constraints)
+
+    x = sym("x_forks")
+    good = FakeGS([UGT(x, BV(10))])
+    bad = FakeGS([UGT(x, BV(10)), ULT(x, BV(3))])
+
+    # host path (small batch)
+    monkeypatch.setattr(args, "tpu_lanes", 0)
+    out = pruner.prune_feasible_states([good, bad])
+    assert out == [good]
+
+    # device path (batched)
+    monkeypatch.setattr(args, "tpu_lanes", 64)
+    monkeypatch.setattr(pruner, "_device_failures", 0)
+    monkeypatch.setattr(pruner, "_device_skip", 0)
+    try:
+        states = [good, bad] * 5
+        out = pruner.prune_feasible_states(states)
+        assert len(out) == 5 and all(s is good for s in out)
+    finally:
+        args.tpu_lanes = 0

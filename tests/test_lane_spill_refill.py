@@ -1,0 +1,94 @@
+"""Host spill/refill (SURVEY.md §5 long-context analog): when live
+lanes exceed device capacity, over-budget forks park to the host, and
+their descendants re-enter the device once lanes free (mid-state
+re-seeding). The stress contract's fork tree (2^6 paths) far exceeds
+the 8-lane engine, and the result must match the host engine exactly."""
+
+import json
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+from mythril_tpu.orchestration.mythril_disassembler import (
+    MythrilDisassembler,
+)
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+from mythril_tpu.support.support_args import args as global_args
+
+OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+
+def _push(v, n=1):
+    return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+
+def _fork_tree_code(k=6):
+    """k sequential symbolic branches with SSTOREs -> 2^k paths."""
+    c = bytearray(_push(0))
+    for i in range(k):
+        c += _push(i) + bytes([OP["CALLDATALOAD"]])
+        c += _push(1) + bytes([OP["AND"], OP["ISZERO"]])
+        j = len(c)
+        c += _push(0, 2) + bytes([OP["JUMPI"]])
+        c += _push(7) + bytes([OP["ADD"], OP["DUP1"]])
+        c += _push(i) + bytes([OP["SSTORE"]])
+        c[j + 1:j + 3] = len(c).to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"]])
+    c += _push(0) + bytes([OP["SSTORE"], OP["STOP"]])
+    return bytes(c)
+
+
+def _reset_modules():
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules(None, None):
+        m.reset_module()
+        m.cache.clear()
+
+
+def _analyze(code_hex, tpu_lanes):
+    _reset_modules()
+    disassembler = MythrilDisassembler(eth=None)
+    address, _ = disassembler.load_from_bytecode(code_hex,
+                                                 bin_runtime=True)
+    cmd_args = SimpleNamespace(
+        execution_timeout=600, max_depth=4096, solver_timeout=25000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=None, unconstrained_storage=False,
+        parallel_solving=False, call_depth_limit=3,
+        disable_dependency_pruning=False, custom_modules_directory="",
+        solver_log=None, transaction_sequences=None,
+        tpu_lanes=tpu_lanes,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+    try:
+        report = analyzer.fire_lasers(modules=None, transaction_count=1)
+    finally:
+        global_args.tpu_lanes = 0
+    out = json.loads(report.as_json())
+    for issue in out.get("issues") or []:
+        issue.pop("discoveryTime", None)
+    return sorted(out.get("issues") or [],
+                  key=lambda i: json.dumps(i, sort_keys=True))
+
+
+def test_spill_refill_capacity_stress():
+    from mythril_tpu.laser import lane_engine
+
+    code_hex = _fork_tree_code().hex()
+    host = _analyze(code_hex, 0)
+    lane_engine.LAST_RUN_STATS = None
+    lane = _analyze(code_hex, 8)  # 64 paths through an 8-lane engine
+    stats = lane_engine.LAST_RUN_STATS
+    assert stats and stats["device_steps"] > 0, stats
+    # refill happened: more seed waves than the lane pool could ever
+    # hold at once (entry states + re-seeded spilled descendants)
+    assert stats["seeded"] > 8, stats
+    assert host == lane, (
+        f"host {len(host)} issues vs lane {len(lane)}"
+    )
