@@ -184,21 +184,23 @@ class _LazyOState:
 
 #: stats of the most recent completed explore() in this process — lets
 #: callers/tests assert the device path genuinely ran (a fallback to the
-#: host interpreter would make lane-vs-host comparisons vacuous)
+#: host interpreter would make lane-vs-host comparisons vacuous).
+#: RUN_STATS_TOTAL accumulates across engines (spill/refill re-sweeps
+#: create several per analysis).
 LAST_RUN_STATS: Optional[dict] = None
+RUN_STATS_TOTAL: Dict[str, int] = {}
 
 
 def _bv_val(v: int) -> BitVec:
     return symbol_factory.BitVecVal(v, 256)
 
 
-def _pow2_bucket(k: int, cap: int) -> int:
-    """Smallest power of two >= k (capped): variable-length host<->device
-    batches are padded to bucketed shapes so each bucket jit-compiles
-    once instead of once per length."""
-    from ..ops.intervals import _next_pow2
-
-    return min(_next_pow2(k), cap)
+def _coarse_bucket(k: int, cap: int, floor: int) -> int:
+    """Two-point bucket {floor, cap}: every distinct shape tuple is a
+    separate XLA compile (expensive through a tunneled backend), so the
+    column-clipping dims use at most two sizes — the padding waste is
+    bounded and the compile count stays O(1) per engine config."""
+    return min(cap, floor) if k <= floor else cap
 
 
 # ---- fused per-window device calls (one dispatch each; every extra
@@ -582,8 +584,8 @@ class LaneEngine:
         self._record_memo: Dict[tuple, int] = {}
         self._fired_sites: set = set()
         self.stats = {
-            "seeded": 0, "forks": 0, "records": 0, "parked": 0,
-            "dead": 0, "device_steps": 0, "windows": 0,
+            "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
+            "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
         }
 
     # -- seeding ------------------------------------------------------------
@@ -757,7 +759,7 @@ class LaneEngine:
             specs.append(spec)
         n_depth = self.lane_kwargs.get("stack_depth", 64)
         mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
-        k = _pow2_bucket(max(len(lanes), 1), n)
+        k = _coarse_bucket(max(len(lanes), 1), n, min(16, n))
         idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
         idx[: len(lanes)] = lanes
         i32p = np.zeros((k, 7 + n_env), np.int32)
@@ -793,6 +795,8 @@ class LaneEngine:
             jnp.asarray(np.int32(len(free))),
         )
         self.stats["seeded"] += len(entries)
+        # mid-path re-entries (the spill/refill path) vs fresh tx seeds
+        self.stats["reseeded"] += sum(1 for s in specs if s["pc"])
         return st
 
     # -- drain ---------------------------------------------------------------
@@ -898,13 +902,13 @@ class LaneEngine:
         empty = jnp.zeros(0, jnp.int32)
         if not len(act) and not nf:
             return _drain_reset(st, empty, empty, empty), []
-        ka = _pow2_bucket(max(len(act), 1), n)
+        ka = _coarse_bucket(max(len(act), 1), n, min(64, n))
         act_pad = np.zeros(ka, np.int32)
         act_pad[: len(act)] = act
-        dmax = _pow2_bucket(
-            max(int(counts_h["dlog_count"].max()), 1), d_recs)
-        pmax = _pow2_bucket(
-            max(int(counts_h["pclog_count"].max()), 1), p_recs)
+        dmax = _coarse_bucket(
+            max(int(counts_h["dlog_count"].max()), 1), d_recs, 8)
+        pmax = _coarse_bucket(
+            max(int(counts_h["pclog_count"].max()), 1), p_recs, 8)
         h = _unpack_logs(jax.device_get(
             _gather_logs_rows(st, jnp.asarray(act_pad), dmax, pmax)))
         row_of = {int(lane): i for i, lane in enumerate(act)}
@@ -1066,7 +1070,7 @@ class LaneEngine:
 
         # 4. provisional sid rewrite (device-side: the sid planes never
         # leave the device) + per-window log reset, one dispatch
-        kp = _pow2_bucket(max(len(prov), 1), n * d_recs)
+        kp = _coarse_bucket(max(len(prov), 1), n * d_recs, 256)
         pl = np.full(kp, n, np.int32)  # padding -> mode=drop
         ps = np.zeros(kp, np.int32)
         po = np.zeros(kp, np.int32)
@@ -1267,19 +1271,20 @@ class LaneEngine:
                 c = self.last_counts
                 rsel = np.asarray(retire, np.int32)
                 lk = self.lane_kwargs
-                dstack = _pow2_bucket(
+                dstack = _coarse_bucket(
                     max(int(c["sp"][rsel].max()), 1),
-                    lk.get("stack_depth", 64))
-                dmem = _pow2_bucket(
+                    lk.get("stack_depth", 64), 16)
+                dmem = _coarse_bucket(
                     max(int(c["msize"][rsel].max()), 1),
-                    lk.get("memory_bytes", 4096))
-                dmlog = _pow2_bucket(
+                    lk.get("memory_bytes", 4096), 512)
+                dmlog = _coarse_bucket(
                     max(int(c["mlog_count"][rsel].max()), 1),
-                    lk.get("mem_records", 64))
-                dslot = _pow2_bucket(
+                    lk.get("mem_records", 64), 8)
+                dslot = _coarse_bucket(
                     max(int(c["scount"][rsel].max()), 1),
-                    lk.get("storage_slots", 64))
-                kr = _pow2_bucket(len(retire), self.n_lanes)
+                    lk.get("storage_slots", 64), 8)
+                kr = _coarse_bucket(len(retire), self.n_lanes,
+                                    min(64, self.n_lanes))
                 ridx = np.full(kr, self.n_lanes, np.int32)
                 ridx[: len(retire)] = retire
                 st, rows = _retire_rows(st, jnp.asarray(ridx),
@@ -1301,4 +1306,6 @@ class LaneEngine:
                 break
         global LAST_RUN_STATS
         LAST_RUN_STATS = dict(self.stats)
+        for key, val in self.stats.items():
+            RUN_STATS_TOTAL[key] = RUN_STATS_TOTAL.get(key, 0) + val
         return results

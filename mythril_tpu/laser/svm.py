@@ -275,7 +275,7 @@ class LaserEVM:
 
     # -- the hot loop -------------------------------------------------------
 
-    def _lane_engine_sweep(self) -> None:
+    def _lane_engine_sweep(self, min_batch: int = 1) -> None:
         """Run tx-entry worklist states through the TPU lane engine
         (laser/lane_engine.py): the device executes the symbolic
         ALU/stack/memory/storage/jump core of every path in batch, forks
@@ -349,8 +349,8 @@ class LaserEVM:
                 eligible.append((code, gs))
             else:
                 rest.append(gs)
-        if not eligible:
-            return
+        if len(eligible) < min_batch:
+            return  # device round trips don't pay for a trickle
         groups: Dict[bytes, List[GlobalState]] = {}
         for code, gs in eligible:
             groups.setdefault(code, []).append(gs)
@@ -418,10 +418,10 @@ class LaserEVM:
                 and not create
                 and not track_gas
                 and iter_since_sweep >= 512
-                and len(self.work_list) >= 16
+                and len(self.work_list) >= 32
             ):
                 iter_since_sweep = 0
-                self._lane_engine_sweep()
+                self._lane_engine_sweep(min_batch=32)
             if new_states:
                 self.work_list += new_states
             elif track_gas:

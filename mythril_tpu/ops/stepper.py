@@ -192,16 +192,31 @@ class CompiledCode(NamedTuple):
     size: int  # real code length (static)
 
 
+# padded code-tensor sizes: every distinct tensor length is a separate
+# XLA compilation of the (large) stepper kernels, so contracts share a
+# handful of padded shapes instead (tail is STOP-filled and unreachable
+# past `size`, which is a traced scalar)
+_CODE_BUCKETS = (256, 1024, 4096, 16384, 65536)
+
+
+def _code_bucket(length: int) -> int:
+    for b in _CODE_BUCKETS:
+        if length <= b:
+            return b
+    return length
+
+
 def compile_code(code: bytes, func_entries=()) -> CompiledCode:
     """func_entries: byte addresses of function entry points (the
     Disassembly's address_to_function_name keys); lanes jumping there
     record it so materialized states carry the active function name."""
     length = len(code)
-    opcode = np.full(length + 1, _OP["STOP"], dtype=np.int32)
-    push_value = np.zeros((length + 1, bv256.NLIMBS), dtype=np.uint32)
-    next_pc = np.arange(1, length + 2, dtype=np.int32)
-    is_jumpdest = np.zeros(length + 1, dtype=bool)
-    is_func_entry = np.zeros(length + 1, dtype=bool)
+    padded = _code_bucket(length)
+    opcode = np.full(padded + 1, _OP["STOP"], dtype=np.int32)
+    push_value = np.zeros((padded + 1, bv256.NLIMBS), dtype=np.uint32)
+    next_pc = np.arange(1, padded + 2, dtype=np.int32)
+    is_jumpdest = np.zeros(padded + 1, dtype=bool)
+    is_func_entry = np.zeros(padded + 1, dtype=bool)
     for addr in func_entries:
         if 0 <= addr <= length:
             is_func_entry[addr] = True

@@ -83,12 +83,12 @@ def test_spill_refill_capacity_stress():
     code_hex = _fork_tree_code().hex()
     host = _analyze(code_hex, 0)
     lane_engine.LAST_RUN_STATS = None
+    lane_engine.RUN_STATS_TOTAL = {}
     lane = _analyze(code_hex, 8)  # 64 paths through an 8-lane engine
-    stats = lane_engine.LAST_RUN_STATS
-    assert stats and stats["device_steps"] > 0, stats
-    # refill happened: more seed waves than the lane pool could ever
-    # hold at once (entry states + re-seeded spilled descendants)
-    assert stats["seeded"] > 8, stats
+    stats = lane_engine.RUN_STATS_TOTAL
+    assert stats.get("device_steps", 0) > 0, stats
+    # refill happened: spilled mid-path descendants re-entered lanes
+    assert stats.get("reseeded", 0) > 0, stats
     assert host == lane, (
         f"host {len(host)} issues vs lane {len(lane)}"
     )
