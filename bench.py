@@ -186,11 +186,12 @@ def bench_symbolic(n_lanes=4096):
     host interpreter, exploring the same 2^k-path workload."""
     code, n_paths = build_symbolic_contract()
     host_s, host_paths = _explore(code, 0)
-    lane_s, lane_paths = _explore(code, n_lanes)
-    assert lane_paths == host_paths, (lane_paths, host_paths)
     from mythril_tpu.laser import lane_engine
 
-    stats = lane_engine.LAST_RUN_STATS or {}
+    lane_engine.RUN_STATS_TOTAL = {}
+    lane_s, lane_paths = _explore(code, n_lanes)
+    assert lane_paths == host_paths, (lane_paths, host_paths)
+    stats = lane_engine.RUN_STATS_TOTAL
     return {
         "metric": "symbolic paths/sec/chip (end-to-end)",
         "value": round(n_paths / lane_s, 1),
