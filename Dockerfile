@@ -1,0 +1,20 @@
+# mythril-tpu: TPU-native symbolic execution for EVM bytecode.
+# The JAX base image must match the target accelerator; for CPU-only
+# use, the plain python image suffices (the engine falls back to the
+# host interpreter and a virtual CPU mesh for sharding tests).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/mythril-tpu
+COPY . .
+RUN pip install --no-cache-dir "jax[cpu]" numpy && \
+    pip install --no-cache-dir .
+
+# build the native layer (keccak, CDCL core, term-tape blaster) ahead
+# of first use
+RUN python -c "from mythril_tpu.native import keccak256; keccak256(b'')"
+
+ENTRYPOINT ["myth"]
+CMD ["help"]

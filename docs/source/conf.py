@@ -1,0 +1,19 @@
+"""Sphinx configuration (reference parity: docs/source/conf.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "mythril-tpu"
+author = "mythril-tpu contributors"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+templates_path = ["_templates"]
+exclude_patterns = []
+html_theme = "alabaster"
