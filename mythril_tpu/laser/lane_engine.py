@@ -28,7 +28,10 @@ and are rewritten to table ids at each drain.
 
 import functools
 import logging
+import os
+import time
 from collections import deque
+from contextlib import contextmanager
 from copy import copy, deepcopy
 from typing import Dict, List, Optional, Tuple
 
@@ -182,6 +185,28 @@ class _LazyOState:
         return getattr(self._gs, name)
 
 
+#: phase wall-clock accumulator (seconds), enabled by MYTHRIL_TPU_PROF=1.
+#: In profiling mode device calls are block_until_ready'd inside their
+#: phase so async dispatch cost lands on the phase that caused it.
+PROF: Dict[str, float] = {}
+PROF_ON = os.environ.get("MYTHRIL_TPU_PROF") == "1"
+
+
+@contextmanager
+def _prof(name: str, sync=None):
+    if not PROF_ON:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync is not None:
+            jax.block_until_ready(sync() if callable(sync) else sync)
+        PROF[name] = PROF.get(name, 0.0) + time.perf_counter() - t0
+        PROF["n_" + name] = PROF.get("n_" + name, 0.0) + 1
+
+
 #: stats of the most recent completed explore() in this process — lets
 #: callers/tests assert the device path genuinely ran (a fallback to the
 #: host interpreter would make lane-vs-host comparisons vacuous).
@@ -212,15 +237,21 @@ import jax.numpy as jnp  # noqa: E402
 
 N_MISC = 4  # dlog_count, pclog_count, status, steps
 
+#: floor bucket for the fused per-window log pull: every window pulls
+#: all lanes' first DFLOOR/PFLOOR log records in the same dispatch as
+#: the run itself; the (rare) window where some lane logged more does
+#: one escalation gather at the cap shape
+DFLOOR = 8
+PFLOOR = 8
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _window_prologue(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
-                     stack_s, mem_v, mem_k, fs, fcount) -> SymLaneState:
-    """Per-window device prologue in ONE dispatch: reset + seed the
-    rows in idx (padded entries hold n -> dropped) from packed host
-    arrays, and refresh the free-slot stack. Mid-path states (host
-    spill/refill, ROADMAP mid-state re-seeding) arrive with nonzero
-    pc/sp/stack/memory columns."""
+
+def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
+                   stack_s, mem_v, mem_k, fs, fcount) -> SymLaneState:
+    """Per-window device prologue: reset + seed the rows in idx (padded
+    entries hold n -> dropped) from packed host arrays, and refresh the
+    free-slot stack. Mid-path states (host spill/refill, ROADMAP
+    mid-state re-seeding) arrive with nonzero pc/sp/stack/memory
+    columns."""
     k = idx.shape[0]
     n_env = st.env.shape[1]
 
@@ -277,16 +308,10 @@ def _window_prologue(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0,),
-                   static_argnums=(2, 3, 4, 5))
-def _retire_rows(st: SymLaneState, ridx, dstack: int, dmem: int,
-                 dmlog: int, dslot: int):
-    """Gather the retired lanes' rows (3 packed arrays, column-clipped
-    to the busiest retired lane — planes are mostly padding) AND mark
-    them free, one dispatch. Padded ridx entries hold n: the status
-    write drops them and the gather clamps (host ignores those rows)."""
-    rc = jnp.clip(ridx, 0, st.pc.shape[0] - 1)
-    k = ridx.shape[0]
+def _retire_gather_core(st: SymLaneState, rc, k: int, dstack: int,
+                        dmem: int, dmlog: int, dslot: int):
+    """Pack k retired lanes' rows into 3 arrays, column-clipped (planes
+    are mostly padding)."""
 
     def flat(x):
         return x.reshape(k, -1)
@@ -310,8 +335,23 @@ def _retire_rows(st: SymLaneState, ridx, dstack: int, dmem: int,
     ], axis=1)
     u8 = jnp.concatenate(
         [st.memory[rc, :dmem], st.mkind[rc, :dmem]], axis=1)
+    return i32, u32, u8
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnums=(2, 3, 4, 5))
+def _retire_rows(st: SymLaneState, ridx, dstack: int, dmem: int,
+                 dmlog: int, dslot: int):
+    """Escalation retire: gather the given lanes' rows AND mark them
+    free, one dispatch — for retired lanes the fused window dispatch
+    could not cover (over its row budget or over a column floor).
+    Padded ridx entries hold n: the status write drops them and the
+    gather clamps (host ignores those rows)."""
+    rc = jnp.clip(ridx, 0, st.pc.shape[0] - 1)
+    rows = _retire_gather_core(st, rc, ridx.shape[0], dstack, dmem,
+                               dmlog, dslot)
     st = st._replace(status=st.status.at[ridx].set(DEAD, mode="drop"))
-    return st, (i32, u32, u8)
+    return st, rows
 
 
 def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
@@ -343,10 +383,9 @@ def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
     return out
 
 
-@jax.jit
-def _window_counts(st: SymLaneState):
-    """Tiny first pull: per-lane counters + scalars (drives the sized
-    log/retire gathers)."""
+def _counts_core(st: SymLaneState):
+    """Per-lane counters + scalars (drives the sized log/retire
+    gathers)."""
     misc = jnp.stack(
         [st.dlog_count, st.pclog_count, st.status, st.steps,
          st.sp, st.scount, st.mlog_count, st.msize], axis=1)
@@ -354,14 +393,9 @@ def _window_counts(st: SymLaneState):
     return misc, scal
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _gather_logs_rows(st: SymLaneState, act, dmax: int, pmax: int):
-    """Log rows of the active lanes only, column-clipped to the busiest
-    lane's record count (log planes are mostly empty padding)."""
+def _gather_logs_core(st: SymLaneState, rc, k, dmax: int, pmax: int):
     from jax import lax
 
-    rc = jnp.clip(act, 0, st.pc.shape[0] - 1)
-    k = act.shape[0]
     dlog = jnp.concatenate([
         st.dlog_op[rc, :dmax, None], st.dlog_pc[rc, :dmax, None],
         st.dlog_step[rc, :dmax, None], st.dlog_fentry[rc, :dmax, None],
@@ -381,6 +415,15 @@ def _gather_logs_rows(st: SymLaneState, act, dmax: int, pmax: int):
     flog = jnp.stack(
         [st.flog_parent, st.flog_child, st.flog_step], axis=1)
     return dlog, pclog, flog
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _gather_logs_rows(st: SymLaneState, act, dmax: int, pmax: int):
+    """Escalation gather: log rows of selected lanes, column-clipped to
+    the busiest lane's record count — only for the rare window whose
+    records exceed the fused pull's floor bucket."""
+    rc = jnp.clip(act, 0, st.pc.shape[0] - 1)
+    return _gather_logs_core(st, rc, act.shape[0], dmax, pmax)
 
 
 def _unpack_logs(pulled):
@@ -407,20 +450,19 @@ def _unpack_logs(pulled):
     return h
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _drain_reset(st: SymLaneState, prov_lanes, prov_slots,
-                 prov_oids) -> SymLaneState:
+def _remap_reset_core(st: SymLaneState, prov_arr) -> SymLaneState:
     """Remap provisional sids to resolved object ids (device-side — the
     sid planes never leave the device) and reset the per-window logs.
-    The resolution table arrives as sparse (lane, slot, oid) triplets —
-    a dense (N, R) plane costs a megabyte of H2D per window on a
-    tunneled link. Unresolved slots hold int32 min so a leaked sid
-    fails loudly instead of aliasing a real record."""
+    Runs at the START of the next window's fused dispatch: the encoding
+    (lane, record-slot) of the previous window's log is still unique
+    until that window's run mints new records, and rows that retired in
+    between are dead (their planes are never read again). The
+    resolution table arrives as a dense (N, R) i32 plane (16 KB at the
+    corpus config — a fixed shape, where a sparse triplet bucket would
+    fork a fresh multi-second jit variant on a record-heavy window).
+    Unresolved slots hold int32 min so a leaked sid fails loudly
+    instead of aliasing a real record."""
     d_recs = st.dlog_op.shape[1]
-    prov_arr = jnp.full((st.pc.shape[0], d_recs),
-                        jnp.iinfo(jnp.int32).min, jnp.int32)
-    prov_arr = prov_arr.at[prov_lanes, prov_slots].set(
-        prov_oids, mode="drop")
 
     def remap(plane):
         negm = plane < 0
@@ -436,6 +478,137 @@ def _drain_reset(st: SymLaneState, prov_lanes, prov_slots,
         pclog_count=jnp.zeros_like(st.pclog_count),
         flog_count=jnp.zeros_like(st.flog_count),
     )
+
+
+#: fast-retire row budget and column floors (stack slots, memory bytes,
+#: memory-overlay records, storage slots) for the in-dispatch retire
+#: gather; lanes over a floor (or past the row budget) stay NEEDS_HOST
+#: and retire through the escalation dispatch instead
+RCAP = 16
+RETIRE_FLOORS = (24, 512, 8, 8)
+
+
+def _unpack_i32_sections(buf, sections):
+    """Split a flat i32 buffer into named (shape, dtype) sections
+    (offsets are static — XLA fuses the slices away)."""
+    from jax import lax
+
+    out = {}
+    off = 0
+    for name, shape, dtype in sections:
+        size = int(np.prod(shape)) if shape else 1
+        part = buf[off:off + size]
+        part = part.reshape(shape) if shape else part[0]
+        if dtype == jnp.uint32:
+            part = lax.bitcast_convert_type(part, jnp.uint32)
+        out[name] = part
+        off += size
+    return out
+
+
+def _seed_sections(n, k, n_env, n_depth, d_recs, midpath):
+    """Layout of the packed per-window i32 buffer (host+device agree).
+    The kill section is lane-count-sized so a window can never overflow
+    it — a capped bucket would let a dead-but-running lane's slot be
+    re-seeded before its deferred kill lands."""
+    sec = [
+        ("idx", (k,), jnp.int32),
+        ("i32p", (k, 7 + n_env), jnp.int32),
+        ("u32p", (k, 1 + n_env * bv256.NLIMBS), jnp.uint32),
+        ("fs", (n,), jnp.int32),
+        ("fcount", (), jnp.int32),
+        ("prov", (n, d_recs), jnp.int32),
+        ("kill", (n,), jnp.int32),
+    ]
+    if midpath:
+        sec += [
+            ("stack_v", (k, n_depth * bv256.NLIMBS), jnp.uint32),
+            ("stack_s", (k, n_depth), jnp.int32),
+        ]
+    return sec
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnums=tuple(range(6, 12)))
+def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
+                 taint_table, window: int, k: int,
+                 midpath: bool, dfloor: int, pfloor: int,
+                 budget: int):
+    """The whole per-window device work in ONE dispatch with TWO packed
+    host->device buffers — on a tunneled backend every dispatch is a
+    full round trip and every input array is a separately-latencied
+    transfer, and those (not compute, not the host bridge) are the
+    measured lane-path deficit. Sequence:
+
+    1. remap the previous window's provisional sids, reset the logs,
+       and kill lanes the host found trivially-false at the last drain;
+    2. seed this window's k entries from the packed buffers (fresh
+       tx-entry seeds carry no stack/memory image — their planes are
+       zero-filled on device; midpath=True adds the spill/refill
+       sections);
+    3. run the window;
+    4. select up to RCAP parked lanes whose rows fit the retire column
+       floors, gather their rows, and mark them DEAD (the host gets
+       back lane indices in ridx; over-budget/over-floor lanes stay
+       NEEDS_HOST for the escalation dispatch);
+    5. return counters and all lanes' log rows clipped to the floor
+       bucket (one escalation gather in the rare over-floor window).
+    """
+    from jax import lax
+
+    n = st.pc.shape[0]
+    n_env = st.env.shape[1]
+    cap = st.calldata.shape[1]
+    n_depth = st.stack.shape[1]
+    mem_cap = st.memory.shape[1]
+    d_recs = st.dlog_op.shape[1]
+    sec = _seed_sections(n, k, n_env, n_depth, d_recs, midpath)
+    a = _unpack_i32_sections(i32buf, sec)
+    if midpath:
+        stack_v, stack_s = a["stack_v"], a["stack_s"]
+    else:
+        stack_v = jnp.zeros((k, n_depth * bv256.NLIMBS), jnp.uint32)
+        stack_s = jnp.zeros((k, n_depth), jnp.int32)
+    u8p = u8buf[:k * cap].reshape(k, cap)
+    if midpath:
+        mem_v = u8buf[k * cap:k * (cap + mem_cap)].reshape(k, mem_cap)
+        mem_k = u8buf[k * (cap + mem_cap):
+                      k * (cap + 2 * mem_cap)].reshape(k, mem_cap)
+    else:
+        mem_v = jnp.zeros((k, mem_cap), jnp.uint8)
+        mem_k = jnp.zeros((k, mem_cap), jnp.uint8)
+
+    st = _remap_reset_core(st, a["prov"])
+    st = st._replace(status=st.status.at[a["kill"]].set(
+        DEAD, mode="drop"))
+    st = _prologue_core(st, a["idx"], a["i32p"], a["u32p"], u8p,
+                        stack_v, stack_s, mem_v, mem_k, a["fs"],
+                        a["fcount"])
+    st = symstep.sym_run(cc, st, window, exec_table, taint_table)
+
+    # 4. in-dispatch fast retire
+    dstack, dmem, dmlog, dslot = RETIRE_FLOORS
+    rcap = min(RCAP, n)
+    parked = (st.status == Status.NEEDS_HOST) | (
+        (st.status == Status.RUNNING) & (st.steps >= budget))
+    fits = (
+        (st.sp <= dstack) & (st.msize <= dmem)
+        & (st.mlog_count <= dmlog) & (st.scount <= dslot))
+    elig = parked & fits
+    order = jnp.cumsum(elig.astype(jnp.int32)) - 1
+    take = elig & (order < rcap)
+    ridx = jnp.full((rcap,), n, jnp.int32)
+    ridx = ridx.at[jnp.where(take, order, rcap)].set(
+        jnp.where(take, jnp.arange(n), n).astype(jnp.int32),
+        mode="drop")
+    rc = jnp.clip(ridx, 0, n - 1)
+    rows = _retire_gather_core(st, rc, rcap, dstack, dmem, dmlog,
+                               dslot)
+    st = st._replace(status=st.status.at[ridx].set(DEAD, mode="drop"))
+
+    misc, scal = _counts_core(st)
+    logs = _gather_logs_core(st, jnp.arange(n), n, dfloor, pfloor)
+    return st, (misc, scal) + logs + (ridx,) + rows
 
 
 def _limbs_int(limbs) -> int:
@@ -516,6 +689,166 @@ def _storage_read_term(seed_raw: "T.Term", key: BitVec) -> BitVec:
 # deferred-record resolution
 # ---------------------------------------------------------------------------
 
+#: CompiledCode per (bytecode, function entries) — the code planes stay
+#: resident on device across transactions, sweeps, and contracts (each
+#: compile_code call costs host decode + five H2D transfers).
+_CC_CACHE: Dict[tuple, object] = {}
+
+#: all-DEAD SymLaneState pool keyed by shape config: a finished engine
+#: parks its device buffers here and the next engine (same shapes —
+#: possibly a different contract) adopts them instead of paying the
+#: init dispatch. A pooled state is interchangeable because every live
+#: field of a lane is fully rewritten when the row is seeded.
+_STATE_POOL: Dict[tuple, List[SymLaneState]] = {}
+
+
+def _compiled_code(code_bytes: bytes, fentries) -> "CompiledCode":
+    key = (code_bytes, tuple(sorted(fentries)))
+    cc = _CC_CACHE.get(key)
+    if cc is None:
+        with _prof("compile_code"):
+            cc = compile_code(code_bytes, func_entries=key[1])
+        if len(_CC_CACHE) >= 64:  # bound device-resident code tensors
+            _CC_CACHE.pop(next(iter(_CC_CACHE)))
+        _CC_CACHE[key] = cc
+    return cc
+
+
+# -- background jit warmup ---------------------------------------------------
+#
+# The fused window dispatch takes ~7-20 s to XLA-compile through a
+# tunneled backend and a persistent-cache hit is even slower (see
+# support/devices.enable_compile_cache). The compile only depends on
+# SHAPES, so a background thread runs one all-dead window per variant
+# while the host interpreter makes progress on the first contract; the
+# sweep only routes work to the device once its variant is warm.
+
+_WARM: Dict[tuple, str] = {}  # variant key -> "pending" | "ready"
+_WARM_LOCK = None
+
+
+def _variant_key(n_lanes: int, code_len: int, lane_kwargs: dict,
+                 window: int, midpath: bool) -> tuple:
+    from ..ops.stepper import _code_bucket
+
+    return (n_lanes, _code_bucket(code_len),
+            tuple(sorted(lane_kwargs.items())), window, midpath)
+
+
+@functools.lru_cache(maxsize=1)
+def _tunneled_backend() -> bool:
+    from ..support.devices import tunneled_backend
+
+    return tunneled_backend()
+
+
+def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
+              window: int, step_budget: int, midpath: bool) -> None:
+    """Compile one window-dispatch variant by running an all-dead
+    window of the exact production shapes, plus the escalation gathers
+    that variant can fall back to mid-run."""
+    from ..ops.stepper import _code_bucket
+
+    eng = LaneEngine(n_lanes=n_lanes, window=window,
+                     step_budget=step_budget, **lane_kwargs)
+    st = eng._acquire_state()
+    # dummy code at the bucket length: shared across warms of the bucket
+    cc = _compiled_code(b"\x00" * _code_bucket(max(code_len, 1)), ())
+    i32buf, u8buf, (k, _) = eng._pack_window(
+        [], [None] * n_lanes, list(range(n_lanes)), [],
+        int(st.calldata.shape[1]))
+    if midpath:
+        # splice in the (all-zero) midpath sections the layout adds
+        n_depth = eng.lane_kwargs.get("stack_depth", 64)
+        mem_cap = eng.lane_kwargs.get("memory_bytes", 4096)
+        i32buf = jnp.asarray(np.concatenate([
+            np.asarray(i32buf),
+            np.zeros(k * (n_depth * bv256.NLIMBS + n_depth), np.int32),
+        ]))
+        u8buf = jnp.asarray(np.concatenate([
+            np.asarray(u8buf), np.zeros(2 * k * mem_cap, np.uint8)]))
+    st, out = _window_exec(
+        st, cc, i32buf, u8buf, eng.exec_table, eng.taint_table,
+        window, k, midpath, DFLOOR, PFLOOR, step_budget)
+    jax.block_until_ready(out)
+    if not midpath:
+        # escalation variants this engine config can hit mid-explore
+        lk = eng.lane_kwargs
+        d_recs = lk.get("dlog_records", 64)
+        p_recs = lk.get("pc_records", 64)
+        act = jnp.zeros(_coarse_bucket(1, n_lanes, min(64, n_lanes)),
+                        jnp.int32)
+        for dmax, pmax in ((d_recs, PFLOOR), (DFLOOR, p_recs),
+                           (d_recs, p_recs)):
+            jax.block_until_ready(
+                _gather_logs_rows(st, act, dmax, pmax))
+        ridx = jnp.full(_coarse_bucket(1, n_lanes, min(64, n_lanes)),
+                        n_lanes, jnp.int32)
+        st, rows = _retire_rows(st, ridx, 16, 512, 8, 8)
+        jax.block_until_ready(rows)
+    eng._release_state(st)
+
+
+def warm_variant(n_lanes: int, code_len: int, lane_kwargs: dict,
+                 window: int, step_budget: int,
+                 midpath: bool = False) -> bool:
+    """True when the (shape-)variant of the fused window dispatch is
+    compiled. On a tunneled backend a cold variant kicks off a
+    BACKGROUND compile and returns False — the caller keeps the work on
+    the host interpreter until the device is worth dispatching to. On
+    local backends the compile runs inline (it is cheap there, and the
+    test suites rely on the sweep deterministically using the device).
+    Thread-safe; never raises."""
+    global _WARM_LOCK
+    import threading
+
+    if _WARM_LOCK is None:
+        _WARM_LOCK = threading.Lock()
+    key = _variant_key(n_lanes, code_len, lane_kwargs, window, midpath)
+    with _WARM_LOCK:
+        state = _WARM.get(key)
+        if state == "ready":
+            return True
+        if state == "pending":
+            return False
+        _WARM[key] = "pending"
+
+    def _compile():
+        try:
+            _warm_one(n_lanes, code_len, lane_kwargs, window,
+                      step_budget, midpath)
+        except Exception as e:  # pragma: no cover - warmup best-effort
+            log.debug("lane warmup failed: %s", e)
+        finally:
+            with _WARM_LOCK:
+                _WARM[key] = "ready"  # worst case: sweep pays compile
+
+    if _tunneled_backend():
+        # ONE sequential worker: concurrent variant compiles would
+        # contend for the tunnel and both arrive late
+        with _WARM_LOCK:
+            queue = _WARM.setdefault("_queue", [])  # type: ignore
+            queue.append(_compile)
+            if _WARM.get("_worker") == "running":
+                return False
+            _WARM["_worker"] = "running"
+
+        def _worker():
+            while True:
+                with _WARM_LOCK:
+                    if not queue:
+                        _WARM["_worker"] = "idle"
+                        return
+                    fn = queue.pop(0)
+                fn()
+
+        threading.Thread(target=_worker, name="lane-warmup",
+                         daemon=True).start()
+        return False
+    _compile()
+    return True
+
+
 # ops whose alu resolver takes pop-coerced bitvec args, keyed by arity
 _ALU2 = {
     "ADD": alu.add, "SUB": alu.sub, "MUL": alu.mul, "DIV": alu.div,
@@ -535,13 +868,17 @@ _ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
                "SLOAD": 1, "CALLDATALOAD": 1})
 
 
+DEFAULT_WINDOW = 48
+DEFAULT_STEP_BUDGET = 8192
+
+
 class LaneEngine:
     """Owns one lane batch + object table for a single contract's
     exploration."""
 
-    def __init__(self, n_lanes: int = 256, window: int = 48,
-                 step_budget: int = 8192, blocked_ops=None,
-                 adapters=None, **lane_kwargs):
+    def __init__(self, n_lanes: int = 256, window: int = DEFAULT_WINDOW,
+                 step_budget: int = DEFAULT_STEP_BUDGET,
+                 blocked_ops=None, adapters=None, **lane_kwargs):
         self.n_lanes = n_lanes
         self.window = window
         self.step_budget = step_budget
@@ -576,6 +913,11 @@ class LaneEngine:
             if op in ad.taint_ops
         }
         self.objects = ObjectTable()
+        # (lane, record-slot) -> object id for the most recent window's
+        # deferred records; the device-side remap of these lands at the
+        # NEXT window's fused dispatch, so retired-row resolution (_obj)
+        # reads this map directly in the meantime
+        self._prov: Dict[Tuple[int, int], int] = {}
         self._func_names: Dict[int, str] = {}
         # repeated CALLDATALOADs at the same offset across lanes resolve
         # to the same word term; building it once matters (32 If+select
@@ -587,6 +929,7 @@ class LaneEngine:
             "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
             "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
         }
+        self.last_run_stats: Optional[dict] = None
 
     # -- seeding ------------------------------------------------------------
     # (eligibility is decided by the caller: svm._lane_engine_sweep)
@@ -742,29 +1085,40 @@ class LaneEngine:
             stack_v=stack_v, stack_s=stack_s, mem_v=mem_v, mem_k=mem_k,
         )
 
-    def seed_all(self, st: SymLaneState, entries,
-                 ctxs: List[Optional[LaneCtx]], free) -> SymLaneState:
-        """One fused device prologue per window: reset + seed the new
-        entries (3 packed host arrays) and refresh the free-slot stack.
-        Called every window even with no entries (the free list changed
-        if lanes retired)."""
-        cap = st.calldata.shape[1]
+    def _pack_window(self, entries, ctxs: List[Optional[LaneCtx]],
+                     free, kill, calldata_cap: int):
+        """Pack EVERYTHING the next window dispatch needs from the host
+        into two flat buffers (one i32, one u8): seed rows, free-slot
+        stack, the previous drain's provisional-sid resolutions, and
+        the kill list — each host->device array pays its own transfer
+        latency on a tunneled link, so the count is what matters.
+        Returns (i32buf, u8buf, statics) with the layout of
+        _seed_sections."""
         n = self.n_lanes
         n_env = symstep.N_ENV
         lanes, specs = [], []
-        for lane, gs in entries:
-            ctx, spec = self._seed_spec(gs, cap)
-            ctxs[lane] = ctx
-            lanes.append(lane)
-            specs.append(spec)
+        with _prof("seed_pack"):
+            for lane, gs in entries:
+                ctx, spec = self._seed_spec(gs, calldata_cap)
+                ctxs[lane] = ctx
+                lanes.append(lane)
+                specs.append(spec)
         n_depth = self.lane_kwargs.get("stack_depth", 64)
         mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
-        k = _coarse_bucket(max(len(lanes), 1), n, min(16, n))
+        d_recs = self.lane_kwargs.get("dlog_records", 64)
+        # ALWAYS the same bucket, even with zero entries: a second
+        # compiled variant of the window dispatch costs far more than a
+        # lifetime of 10 KB all-padding seed sections (explore caps
+        # entries per window to this bucket)
+        k = min(16, n)
+        assert len(lanes) <= k
+        midpath = any(s["pc"] or s["sp"] or s["msize"] for s in specs)
+
         idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
         idx[: len(lanes)] = lanes
         i32p = np.zeros((k, 7 + n_env), np.int32)
         u32p = np.zeros((k, 1 + n_env * bv256.NLIMBS), np.uint32)
-        u8p = np.zeros((k, cap), np.uint8)
+        u8p = np.zeros((k, calldata_cap), np.uint8)
         stack_v = np.zeros((k, n_depth * bv256.NLIMBS), np.uint32)
         stack_s = np.zeros((k, n_depth), np.int32)
         mem_v = np.zeros((k, mem_cap), np.uint8)
@@ -787,17 +1141,31 @@ class LaneEngine:
             mem_k[i] = s["mem_k"]
         fs = np.zeros(n, np.int32)
         fs[: len(free)] = free
-        st = _window_prologue(
-            st, jnp.asarray(idx), jnp.asarray(i32p), jnp.asarray(u32p),
-            jnp.asarray(u8p), jnp.asarray(stack_v),
-            jnp.asarray(stack_s), jnp.asarray(mem_v),
-            jnp.asarray(mem_k), jnp.asarray(fs),
-            jnp.asarray(np.int32(len(free))),
-        )
+        prov_arr = np.full((n, d_recs), np.iinfo(np.int32).min,
+                           np.int32)
+        for (lane, slot), oid in self._prov.items():
+            prov_arr[lane, slot] = oid
+        kl = np.full(n, n, np.int32)
+        kl[: len(kill)] = kill
+
+        parts = [idx, i32p.reshape(-1), u32p.reshape(-1).view(np.int32),
+                 fs, np.array([len(free)], np.int32),
+                 prov_arr.reshape(-1), kl]
+        if midpath:
+            parts += [stack_v.reshape(-1).view(np.int32),
+                      stack_s.reshape(-1)]
+        i32buf = np.concatenate([np.ascontiguousarray(p, np.int32)
+                                 for p in parts])
+        u8_parts = [u8p.reshape(-1)]
+        if midpath:
+            u8_parts += [mem_v.reshape(-1), mem_k.reshape(-1)]
+        u8buf = np.concatenate(u8_parts)
+
         self.stats["seeded"] += len(entries)
         # mid-path re-entries (the spill/refill path) vs fresh tx seeds
         self.stats["reseeded"] += sum(1 for s in specs if s["pc"])
-        return st
+        return (jnp.asarray(i32buf), jnp.asarray(u8buf),
+                (k, midpath))
 
     # -- drain ---------------------------------------------------------------
 
@@ -868,54 +1236,19 @@ class LaneEngine:
         for ad in self.adapters:
             ad.on_jumpi_site(cond, site)
 
-    def drain(self, st: SymLaneState,
-              ctxs: List[Optional[LaneCtx]]) -> Tuple[SymLaneState,
-                                                      List[int]]:
-        """Resolve all device logs; returns (updated state, dead lanes).
-        Dead lanes are paths whose latest condition folded to false (the
-        jumpi_ handler's trivial-falsity pruning)."""
-        import jax
-        import jax.numpy as jnp
-
-        d_recs = st.dlog_op.shape[1]
-        p_recs = st.pclog_sid.shape[1]
-        n = st.pc.shape[0]
-
-        # two-phase sized transfer: tiny counters first, then only the
-        # active lanes' log rows clipped to the busiest lane's record
-        # count — log planes are mostly empty padding, and both the
-        # per-pull latency AND the byte volume matter on a tunneled link
-        misc, scal = [np.asarray(x) for x in
-                      jax.device_get(_window_counts(st))]
-        counts_h = {
-            "dlog_count": misc[:, 0], "pclog_count": misc[:, 1],
-            "status": misc[:, 2], "steps": misc[:, 3],
-            "sp": misc[:, 4], "scount": misc[:, 5],
-            "mlog_count": misc[:, 6], "msize": misc[:, 7],
-            "flog_count": int(scal[0]), "free_count": int(scal[1]),
-        }
-        self.last_counts = counts_h  # explore reads these (one pull)
+    def _drain_host(self, h: dict, row_of: Dict[int, int],
+                    counts_h: dict,
+                    ctxs: List[Optional[LaneCtx]]
+                    ) -> Tuple[Dict[Tuple[int, int], int], List[int]]:
+        """Resolve one window's pulled logs into facade terms; returns
+        (provisional-sid resolutions, dead lanes). Dead lanes are paths
+        whose latest condition folded to false (the jumpi_ handler's
+        trivial-falsity pruning). Pure host work — the provisional
+        remap + log reset ride the NEXT window's fused dispatch."""
+        d_recs = self.lane_kwargs.get("dlog_records", 64)
         nf = counts_h["flog_count"]
-        act = np.nonzero(
-            (counts_h["dlog_count"] > 0) | (counts_h["pclog_count"] > 0)
-        )[0].astype(np.int32)
-        empty = jnp.zeros(0, jnp.int32)
-        if not len(act) and not nf:
-            return _drain_reset(st, empty, empty, empty), []
-        ka = _coarse_bucket(max(len(act), 1), n, min(64, n))
-        act_pad = np.zeros(ka, np.int32)
-        act_pad[: len(act)] = act
-        dmax = _coarse_bucket(
-            max(int(counts_h["dlog_count"].max()), 1), d_recs, 8)
-        pmax = _coarse_bucket(
-            max(int(counts_h["pclog_count"].max()), 1), p_recs, 8)
-        h = _unpack_logs(jax.device_get(
-            _gather_logs_rows(st, jnp.asarray(act_pad), dmax, pmax)))
-        row_of = {int(lane): i for i, lane in enumerate(act)}
-        h["dlog_count"] = counts_h["dlog_count"]
-        h["pclog_count"] = counts_h["pclog_count"]
-        h["flog_count"] = nf
 
+        _t_drain_py = time.perf_counter() if PROF_ON else 0.0
         # 1. fork genealogy (flog is already in step order)
         for i in range(nf):
             parent = int(h["flog_parent"][i])
@@ -1068,21 +1401,24 @@ class LaneEngine:
                 for step, ad_id, ann in plist:
                     promos.setdefault(ad_id, []).append((step, ann))
 
-        # 4. provisional sid rewrite (device-side: the sid planes never
-        # leave the device) + per-window log reset, one dispatch
-        kp = _coarse_bucket(max(len(prov), 1), n * d_recs, 256)
-        pl = np.full(kp, n, np.int32)  # padding -> mode=drop
-        ps = np.zeros(kp, np.int32)
-        po = np.zeros(kp, np.int32)
-        for i, ((lane, k), oid) in enumerate(prov.items()):
-            pl[i] = lane
-            ps[i] = k
-            po[i] = oid
-        st = _drain_reset(st, jnp.asarray(pl), jnp.asarray(ps),
-                          jnp.asarray(po))
-        return st, dead
+        if PROF_ON:
+            PROF["drain_py"] = PROF.get("drain_py", 0.0) \
+                + time.perf_counter() - _t_drain_py
+        return prov, dead
 
     # -- materialization -----------------------------------------------------
+
+    def _obj(self, sid: int):
+        """Object for a retired-row sid: positive sids index the table;
+        negative sids are this window's provisional records, resolved
+        through the drain's (lane, slot) map (the device-side remap only
+        lands at the NEXT window's dispatch — retired rows are pulled
+        before that)."""
+        if sid > 0:
+            return self.objects[sid]
+        d_recs = self.lane_kwargs.get("dlog_records", 64)
+        idx = -sid - 1
+        return self.objects[self._prov[(idx // d_recs, idx % d_recs)]]
 
     def materialize(self, st_host: dict, lane: int,
                     ctx: LaneCtx) -> GlobalState:
@@ -1118,7 +1454,7 @@ class LaneEngine:
         for s in range(sp):
             sid = int(st_host["ssid"][lane, s])
             if sid:
-                ms.stack.append(self.objects[sid])
+                ms.stack.append(self._obj(sid))
             else:
                 ms.stack.append(
                     _bv_val(_limbs_int(st_host["stack"][lane, s])))
@@ -1140,7 +1476,7 @@ class LaneEngine:
             for r in range(int(st_host["mlog_count"][lane])):
                 off = int(st_host["mlog_off"][lane, r])
                 ln = int(st_host["mlog_len"][lane, r])
-                obj = self.objects[int(st_host["mlog_sid"][lane, r])]
+                obj = self._obj(int(st_host["mlog_sid"][lane, r]))
                 for j in range(ln):
                     sym_cover[off + j] = (obj, j)
             for i in np.nonzero(kind)[0]:
@@ -1174,7 +1510,7 @@ class LaneEngine:
             if written:
                 any_written = True
                 if sid:
-                    acct.storage[key] = self.objects[sid]
+                    acct.storage[key] = self._obj(sid)
                 else:
                     acct.storage[key] = _bv_val(
                         _limbs_int(st_host["svals"][lane, r]))
@@ -1221,18 +1557,24 @@ class LaneEngine:
             getattr(entry_states[0].environment.code,
                     "address_to_function_name", {}) or {}
         ) if entry_states else {}
-        cc = compile_code(code_bytes,
-                          func_entries=self._func_names.keys())
-        st = symstep.init_sym_lanes(self.n_lanes, **self.lane_kwargs)
+        stats0 = dict(self.stats)  # engines persist across explores
+        cc = _compiled_code(code_bytes, self._func_names.keys())
+        st = self._acquire_state()
         ctxs: List[Optional[LaneCtx]] = [None] * self.n_lanes
         queue = deque(entry_states)
         free = list(range(self.n_lanes - 1, -1, -1))
         results: List[GlobalState] = []
+        calldata_cap = int(st.calldata.shape[1])
+        d_recs = int(st.dlog_op.shape[1])
+        p_recs = int(st.pclog_sid.shape[1])
+        n = self.n_lanes
         import jax.numpy as jnp
 
+        kill: List[int] = []
+        seed_cap = min(16, self.n_lanes)  # one jit variant per layout
         while True:
             entries = []
-            while queue and free:
+            while queue and free and len(entries) < seed_cap:
                 gs = queue.popleft()
                 if self.adapters and not all(
                     ad.seed_ok(gs) for ad in self.adapters
@@ -1240,36 +1582,99 @@ class LaneEngine:
                     results.append(gs)  # host handles this entry
                     continue
                 entries.append((free.pop(), gs))
-            st = self.seed_all(st, entries, ctxs, free)
+            i32buf, u8buf, (k, midpath) = self._pack_window(
+                entries, ctxs, free, kill, calldata_cap)
             n_free_written = len(free)
-            st = symstep.sym_run_jit(cc, st, self.window,
-                                     self.exec_table, self.taint_table)
+            _tw = time.perf_counter() if PROF_ON else 0.0
+            with _prof("window_exec", sync=lambda: st.pc):
+                st, out = _window_exec(
+                    st, cc, i32buf, u8buf, self.exec_table,
+                    self.taint_table, self.window, k, midpath,
+                    DFLOOR, PFLOOR, self.step_budget)
+            # the kill landed at the dispatch's reset phase: only now
+            # may the slots be recycled (they enter the free stack the
+            # device sees at the NEXT dispatch)
+            for lane in kill:
+                ctxs[lane] = None
+                free.append(lane)
+            kill = []
+            if PROF_ON:
+                PROF.setdefault("windows", []).append(  # type: ignore
+                    (round(time.perf_counter() - _tw, 3), k,
+                     int(midpath), len(code_bytes)))
             self.stats["windows"] += 1
-            st, dead = self.drain(st, ctxs)
-            # drain pulled status/steps/free_count in its counts batch
-            status = self.last_counts["status"].copy()
-            steps = self.last_counts["steps"]
+            with _prof("window_pull"):
+                (misc, scal, dlogf, pclogf, flogf, ridx, r_i32, r_u32,
+                 r_u8) = [np.asarray(x) for x in jax.device_get(out)]
+            counts_h = {
+                "dlog_count": misc[:, 0], "pclog_count": misc[:, 1],
+                "status": misc[:, 2], "steps": misc[:, 3],
+                "sp": misc[:, 4], "scount": misc[:, 5],
+                "mlog_count": misc[:, 6], "msize": misc[:, 7],
+                "flog_count": int(scal[0]),
+                "free_count": int(scal[1]),
+            }
+            self.last_counts = counts_h
+            # floor-bucket logs cover the typical window; escalate with
+            # one extra sized gather when some lane logged past a floor
+            dmax_seen = int(counts_h["dlog_count"].max()) if n else 0
+            pmax_seen = int(counts_h["pclog_count"].max()) if n else 0
+            if dmax_seen > DFLOOR or pmax_seen > PFLOOR:
+                act = np.nonzero(
+                    (counts_h["dlog_count"] > 0)
+                    | (counts_h["pclog_count"] > 0))[0].astype(np.int32)
+                ka = _coarse_bucket(max(len(act), 1), n, min(64, n))
+                act_pad = np.zeros(ka, np.int32)
+                act_pad[: len(act)] = act
+                dmax = _coarse_bucket(max(dmax_seen, 1), d_recs, 8)
+                pmax = _coarse_bucket(max(pmax_seen, 1), p_recs, 8)
+                with _prof("logs_escalate"):
+                    h = _unpack_logs(jax.device_get(_gather_logs_rows(
+                        st, jnp.asarray(act_pad), dmax, pmax)))
+                row_of = {int(lane): i for i, lane in enumerate(act)}
+            else:
+                h = _unpack_logs((dlogf, pclogf, flogf))
+                row_of = {lane: lane for lane in range(n)}
+            h["flog_parent"] = flogf[:, 0]
+            h["flog_child"] = flogf[:, 1]
+            h["flog_step"] = flogf[:, 2]
+            h["dlog_count"] = counts_h["dlog_count"]
+            h["pclog_count"] = counts_h["pclog_count"]
+            self._prov, dead = self._drain_host(h, row_of, counts_h,
+                                                ctxs)
+            status = counts_h["status"].copy()
+            steps = counts_h["steps"]
             # forked children consumed slots from the top (tail) of the
             # free stack; reconcile before re-seeding
-            consumed = n_free_written - int(self.last_counts["free_count"])
+            consumed = n_free_written - counts_h["free_count"]
             if consumed:
                 free = free[: n_free_written - consumed]
-            # force-park runaway lanes (host loop-bound machinery takes
-            # over from the materialized state)
+
+            dead_set = set(dead)
+            # 1. fast-retired lanes: the window dispatch already
+            # gathered their rows and marked them DEAD (ridx row i is
+            # the i-th retired lane; padding entries hold n)
+            fast = [int(x) for x in ridx if x < n]
+            if fast:
+                st_fast = _unpack_rows((r_i32, r_u32, r_u8),
+                                       *RETIRE_FLOORS)
+                with _prof("materialize"):
+                    for row, lane in enumerate(fast):
+                        self.stats["device_steps"] += int(steps[lane])
+                        if lane not in dead_set:
+                            results.append(self.materialize(
+                                st_fast, row, ctxs[lane]))
+                        ctxs[lane] = None
+                        free.append(lane)
+            # 2. escalation: parked lanes past the fast budget or over
+            # a column floor (status still NEEDS_HOST), plus runaways
             runaway = (status == Status.RUNNING) \
                 & (steps >= self.step_budget)
-            parked = (status == Status.NEEDS_HOST) | runaway
-            for lane in dead:
-                parked[lane] = False
-
-            retire = sorted(set(np.nonzero(parked)[0].tolist())
-                            | set(dead))
-            if retire:
-                # transfer only the retired lanes' rows and mark them
-                # free in the same fused call (the memory/stack planes
-                # dominate bytes; the dispatch count dominates latency)
-                c = self.last_counts
-                rsel = np.asarray(retire, np.int32)
+            rest = np.nonzero(
+                (status == Status.NEEDS_HOST) | runaway)[0].tolist()
+            if rest:
+                c = counts_h
+                rsel = np.asarray(rest, np.int32)
                 lk = self.lane_kwargs
                 dstack = _coarse_bucket(
                     max(int(c["sp"][rsel].max()), 1),
@@ -1283,29 +1688,62 @@ class LaneEngine:
                 dslot = _coarse_bucket(
                     max(int(c["scount"][rsel].max()), 1),
                     lk.get("storage_slots", 64), 8)
-                kr = _coarse_bucket(len(retire), self.n_lanes,
+                kr = _coarse_bucket(len(rest), self.n_lanes,
                                     min(64, self.n_lanes))
-                ridx = np.full(kr, self.n_lanes, np.int32)
-                ridx[: len(retire)] = retire
-                st, rows = _retire_rows(st, jnp.asarray(ridx),
-                                        dstack, dmem, dmlog, dslot)
-                st_host = _unpack_rows(jax.device_get(rows),
-                                       dstack, dmem, dmlog, dslot)
-                dead_set = set(dead)
-                for row, lane in enumerate(retire):
-                    self.stats["device_steps"] += int(steps[lane])
-                    if lane not in dead_set:
-                        results.append(
-                            self.materialize(st_host, row, ctxs[lane]))
-                    ctxs[lane] = None
-                    free.append(lane)
-                status[np.asarray(retire, np.int32)] = DEAD
+                ridx2 = np.full(kr, self.n_lanes, np.int32)
+                ridx2[: len(rest)] = rest
+                with _prof("retire_pull"):
+                    st, rows = _retire_rows(st, jnp.asarray(ridx2),
+                                            dstack, dmem, dmlog, dslot)
+                    st_host = _unpack_rows(jax.device_get(rows),
+                                           dstack, dmem, dmlog, dslot)
+                with _prof("materialize"):
+                    for row, lane in enumerate(rest):
+                        self.stats["device_steps"] += int(steps[lane])
+                        if lane not in dead_set:
+                            results.append(self.materialize(
+                                st_host, row, ctxs[lane]))
+                        ctxs[lane] = None
+                        free.append(lane)
+                status[rsel] = DEAD
+            # 3. trivially-false lanes still RUNNING on device: kill
+            # them at the next dispatch (before it seeds anything) and
+            # recycle their slots after it. Their host status stays
+            # RUNNING so the loop always runs that dispatch.
+            retired = set(fast) | set(rest)
+            for lane in dead:
+                if lane not in retired:
+                    kill.append(lane)
 
             running = int(np.sum(status == Status.RUNNING))
             if not running and not queue:
                 break
+        self._release_state(st)
         global LAST_RUN_STATS
-        LAST_RUN_STATS = dict(self.stats)
-        for key, val in self.stats.items():
+        delta = {k: v - stats0.get(k, 0) for k, v in self.stats.items()}
+        LAST_RUN_STATS = self.last_run_stats = delta
+        for key, val in delta.items():
             RUN_STATS_TOTAL[key] = RUN_STATS_TOTAL.get(key, 0) + val
         return results
+
+    # -- device-state pooling ------------------------------------------------
+
+    def _shape_key(self) -> tuple:
+        return (self.n_lanes,) + tuple(sorted(self.lane_kwargs.items()))
+
+    def _acquire_state(self) -> SymLaneState:
+        pool = _STATE_POOL.get(self._shape_key())
+        if pool:
+            return pool.pop()
+        with _prof("init_lanes"):
+            return symstep.init_sym_lanes(self.n_lanes,
+                                          **self.lane_kwargs)
+
+    def _release_state(self, st: SymLaneState) -> None:
+        """Park the (all-DEAD) device buffers for the next explore —
+        possibly by a different engine or contract. Stale plane contents
+        are unreachable: seeding rewrites every live field of a row, and
+        log counters were reset by the window dispatches."""
+        pool = _STATE_POOL.setdefault(self._shape_key(), [])
+        if len(pool) < 2:  # bound device memory held by idle batches
+            pool.append(st)

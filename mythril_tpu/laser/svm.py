@@ -356,25 +356,53 @@ class LaserEVM:
             groups.setdefault(code, []).append(gs)
         del self.work_list[:]
         self.work_list.extend(rest)
+        # engines persist across sweeps/transactions: the device state
+        # pool, object table, and term memos all stay warm (a fresh
+        # engine per sweep pays the init dispatch + cold caches)
+        cache = getattr(self, "_lane_engines", None)
+        if cache is None:
+            cache = self._lane_engines = {}
+        from .lane_engine import (
+            DEFAULT_STEP_BUDGET, DEFAULT_WINDOW, warm_variant,
+        )
+
         for code, states in groups.items():
+            # route to the device only once its jit variant is compiled
+            # (on a tunneled backend the compile runs in a background
+            # thread while the host interpreter takes this batch)
+            ready = warm_variant(args.tpu_lanes, len(code), {},
+                                 DEFAULT_WINDOW, DEFAULT_STEP_BUDGET,
+                                 midpath=False)
+            if any(gs.mstate.pc for gs in states):
+                ready = warm_variant(
+                    args.tpu_lanes, len(code), {}, DEFAULT_WINDOW,
+                    DEFAULT_STEP_BUDGET, midpath=True) and ready
+            if not ready:
+                self.work_list.extend(states)
+                continue
+            key = (code, args.tpu_lanes, frozenset(blocked),
+                   tuple(id(a) for a in adapters))
             try:
-                engine = LaneEngine(n_lanes=args.tpu_lanes,
-                                    blocked_ops=blocked,
-                                    adapters=adapters)
+                engine = cache.get(key)
+                if engine is None:
+                    engine = LaneEngine(n_lanes=args.tpu_lanes,
+                                        blocked_ops=blocked,
+                                        adapters=adapters)
+                    cache[key] = engine
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(
                     "lane engine failed (%s); continuing host-side", e)
                 self.work_list.extend(states)
                 continue
+            run = engine.last_run_stats
             self.work_list.extend(parked)
-            self.total_states += engine.stats["device_steps"]
+            self.total_states += run["device_steps"]
             log.info(
                 "lane engine: %d entries -> %d parked states "
                 "(%d forks, %d device steps, %d records, %d windows)",
-                len(states), len(parked), engine.stats["forks"],
-                engine.stats["device_steps"], engine.stats["records"],
-                engine.stats["windows"],
+                len(states), len(parked), run["forks"],
+                run["device_steps"], run["records"], run["windows"],
             )
 
     def exec(self, create=False, track_gas=False

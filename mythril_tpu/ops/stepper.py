@@ -182,14 +182,39 @@ def _build_tables():
 class CompiledCode(NamedTuple):
     """Per-pc tensors precompiled from bytecode (host-side, once per
     contract — the analog of the reference's Disassembly object for the
-    device path)."""
+    device path).
 
-    opcode: jnp.ndarray  # (L+1,) int32, padded with STOP
-    push_value: jnp.ndarray  # (L+1, 8) uint32: 256-bit immediate at pc
-    next_pc: jnp.ndarray  # (L+1,) int32: pc + 1 + push_len
-    is_jumpdest: jnp.ndarray  # (L+1,) bool
-    is_func_entry: jnp.ndarray  # (L+1,) bool — selector-dispatch targets
+    Stored as ONE packed (L+1, 12) i32 device array: separate per-field
+    H2D transfers each pay full link latency on a tunneled backend, and
+    a jitted unpack dispatch pays an XLA compile per code bucket. The
+    field views below slice the packed array — inside a trace XLA fuses
+    them away; outside they are cheap lazy device ops."""
+
+    packed: jnp.ndarray  # (L+1, 12) int32, see column layout below
     size: int  # real code length (static)
+
+    @property
+    def opcode(self):  # (L+1,) int32, padded with STOP
+        return self.packed[:, 0]
+
+    @property
+    def next_pc(self):  # (L+1,) int32: pc + 1 + push_len
+        return self.packed[:, 1]
+
+    @property
+    def is_jumpdest(self):  # (L+1,) bool
+        return self.packed[:, 2].astype(bool)
+
+    @property
+    def is_func_entry(self):  # (L+1,) bool — selector-dispatch targets
+        return self.packed[:, 3].astype(bool)
+
+    @property
+    def push_value(self):  # (L+1, 8) u32: 256-bit immediate at pc
+        from jax import lax
+
+        return lax.bitcast_convert_type(
+            self.packed[:, 4:4 + bv256.NLIMBS], jnp.uint32)
 
 
 # padded code-tensor sizes: every distinct tensor length is a separate
@@ -234,14 +259,13 @@ def compile_code(code: bytes, func_entries=()) -> CompiledCode:
             is_jumpdest[i] = True
         i = next_pc[i]
 
-    return CompiledCode(
-        opcode=jnp.asarray(opcode),
-        push_value=jnp.asarray(push_value),
-        next_pc=jnp.asarray(next_pc),
-        is_jumpdest=jnp.asarray(is_jumpdest),
-        is_func_entry=jnp.asarray(is_func_entry),
-        size=length,
-    )
+    packed = np.concatenate([
+        opcode[:, None], next_pc[:, None],
+        is_jumpdest[:, None].astype(np.int32),
+        is_func_entry[:, None].astype(np.int32),
+        push_value.view(np.int32),
+    ], axis=1)
+    return CompiledCode(packed=jnp.asarray(packed), size=length)
 
 
 # ---------------------------------------------------------------------------

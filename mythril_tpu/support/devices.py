@@ -12,14 +12,34 @@ which _clear_backends does not clear).
 import os
 
 
+def tunneled_backend() -> bool:
+    """True when the default backend is a tunneled remote chip (the
+    axon plugin): dispatches, transfers, and executable loads each pay
+    network latency there, which changes several cost tradeoffs."""
+    import jax
+
+    try:
+        return "axon" in jax.devices()[0].client.platform_version
+    except Exception:
+        return False
+
+
 def enable_compile_cache() -> None:
     """Persistent XLA compilation cache: the lane-engine kernels take
-    tens of seconds to compile; caching them across processes makes CLI
-    runs pay it once per kernel shape, not once per invocation."""
+    seconds to compile; caching them across processes makes CLI runs
+    pay it once per kernel shape, not once per invocation.
+
+    Deliberately DISABLED on the tunneled axon backend: measured there,
+    deserializing a cached lane-engine executable takes 14-95 s while
+    compiling it fresh takes ~7 s — a persistent-cache hit is strictly
+    worse than the miss. (Local CPU/TPU backends keep the cache.)"""
     import jax
 
     import getpass
     import tempfile
+
+    if tunneled_backend():
+        return
 
     cache_dir = os.path.join(
         tempfile.gettempdir(),

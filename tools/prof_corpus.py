@@ -1,0 +1,23 @@
+import sys; sys.path.insert(0, '/root/repo')
+import json, os, time
+os.environ.setdefault("MYTHRIL_TPU_PROF", "1")
+from pathlib import Path
+from bench_corpus import analyze_one
+from mythril_tpu.laser import lane_engine
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+res = []
+t0 = time.perf_counter()
+for p in sorted(INPUTS.glob("*.sol.o")):
+    t1 = time.perf_counter()
+    r = analyze_one(p, 60, tpu_lanes=int(os.environ.get("PROF_LANES", "64")))
+    r["wall_s"] = round(time.perf_counter()-t1, 2)
+    res.append(r)
+    print(json.dumps(r), flush=True)
+total = time.perf_counter()-t0
+wins = lane_engine.PROF.pop("windows", [])
+slow = [w for w in wins if w[0] > 0.3]
+phases = {k: round(v, 2) for k, v in sorted(lane_engine.PROF.items(), key=lambda kv: -kv[1]) if not k.startswith("n_")}
+counts = {k[2:]: int(v) for k, v in lane_engine.PROF.items() if k.startswith("n_")}
+print(json.dumps({"total_wall_s": round(total, 1), "n_windows": len(wins), "slow_windows": slow}))
+print(json.dumps({"phase_s": phases, "phase_calls": counts, "run_stats": lane_engine.RUN_STATS_TOTAL}))

@@ -1,0 +1,56 @@
+"""Phase-level profile of the lane engine on corpus fixtures.
+
+Usage: MYTHRIL_TPU_PROF=1 python tools/prof_lanes.py [fixture ...]
+       (fixture names under /root/reference/tests/testdata/inputs;
+        default is a heavy-4 subset)
+
+Prints per-contract wall clock with lanes on, then the accumulated
+lane_engine.PROF phase table (seconds + call counts) and engine stats.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("MYTHRIL_TPU_PROF", "1")
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+DEFAULT = ["calls.sol.o", "ether_send.sol.o", "flag_array.sol.o",
+           "underflow.sol.o"]
+
+
+def main():
+    names = sys.argv[1:] or DEFAULT
+    lanes = int(os.environ.get("PROF_LANES", "64"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from bench_corpus import analyze_one
+    from mythril_tpu.laser import lane_engine
+
+    total = 0.0
+    for name in names:
+        t0 = time.perf_counter()
+        r = analyze_one(INPUTS / name, 60, tpu_lanes=lanes)
+        total += time.perf_counter() - t0
+        print(json.dumps(r), flush=True)
+    print(json.dumps({"total_wall_s": round(total, 2),
+                      "run_stats": lane_engine.RUN_STATS_TOTAL}))
+    wins = lane_engine.PROF.pop("windows", [])
+    phases = {k: round(v, 3) for k, v in
+              sorted(lane_engine.PROF.items(),
+                     key=lambda kv: -kv[1])
+              if not k.startswith("n_")}
+    print(json.dumps({"windows": wins}))
+    counts = {k[2:]: int(v) for k, v in lane_engine.PROF.items()
+              if k.startswith("n_")}
+    print(json.dumps({"phase_s": phases, "phase_calls": counts}))
+    print(json.dumps({
+        "lane_total_s": round(sum(
+            v for k, v in lane_engine.PROF.items()
+            if not k.startswith("n_") and k != "drain_py"), 2)}))
+
+
+if __name__ == "__main__":
+    main()
