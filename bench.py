@@ -16,10 +16,20 @@ Prints ONE JSON line:
 """
 
 import json
+import os
+import statistics
 import sys
 import time
 
 import numpy as np
+
+TRIALS = max(1, int(os.environ.get("BENCH_TRIALS", "3")))
+
+
+def _spread(xs):
+    return {"median": round(statistics.median(xs), 2),
+            "min": round(min(xs), 2), "max": round(max(xs), 2),
+            "trials": len(xs)}
 
 
 def build_contract():
@@ -82,16 +92,17 @@ def bench_device(code, n_lanes=32768, repeats=3):
     jax.block_until_ready(out.pc)
     assert int((out.status == stepper.Status.RUNNING).sum()) == 0
 
-    best = float("inf")
+    walls = []
     total_instr = int(out.steps.sum())
-    for _ in range(repeats):
+    for _ in range(max(repeats, TRIALS)):
         st = make_batch()
         jax.block_until_ready(st.pc)
         t0 = time.perf_counter()
         out = run(cc, st, max_steps)
         jax.block_until_ready(out.pc)
-        best = min(best, time.perf_counter() - t0)
-    return n_lanes / best, total_instr / best
+        walls.append(time.perf_counter() - t0)
+    med = statistics.median(walls)
+    return n_lanes / med, total_instr / med, _spread(walls)
 
 
 def bench_host(code):
@@ -181,32 +192,143 @@ def _explore(code, tpu_lanes):
     return elapsed, len(sym.laser.open_states)
 
 
-def bench_symbolic(n_lanes=4096):
+def bench_symbolic(n_lanes=4096, trials=None):
     """Symbolic end-to-end: device symstep + drain + host bridge vs the
-    host interpreter, exploring the same 2^k-path workload."""
+    host interpreter, exploring the same 2^k-path workload. Interleaved
+    trials (host, lane, host, lane, ...) with medians — single-trial
+    wall clocks on this box swing +-30% (BASELINE.md). The lane run is
+    measured steady-state: the jit variants compile (once per
+    process+shape) before the clock starts — the host baseline pays no
+    compile either, and in analysis workloads the compile overlaps the
+    host phase via the background warm thread."""
+    trials = trials or TRIALS
     code, n_paths = build_symbolic_contract()
-    host_s, host_paths = _explore(code, 0)
     from mythril_tpu.laser import lane_engine
 
+    for bucket in (16, n_lanes):
+        lane_engine.warm_variant(n_lanes, len(code), {}, 48, 8192,
+                                 seed_bucket=bucket, block=True)
+    host_walls, lane_walls = [], []
     lane_engine.RUN_STATS_TOTAL = {}
-    lane_s, lane_paths = _explore(code, n_lanes)
-    assert lane_paths == host_paths, (lane_paths, host_paths)
+    for _ in range(trials):
+        host_s, host_paths = _explore(code, 0)
+        host_walls.append(host_s)
+        lane_s, lane_paths = _explore(code, n_lanes)
+        lane_walls.append(lane_s)
+        assert lane_paths == host_paths, (lane_paths, host_paths)
     stats = lane_engine.RUN_STATS_TOTAL
+    lane_med = statistics.median(lane_walls)
+    host_med = statistics.median(host_walls)
     return {
         "metric": "symbolic paths/sec/chip (end-to-end)",
-        "value": round(n_paths / lane_s, 1),
+        "value": round(n_paths / lane_med, 1),
         "unit": "paths/s",
-        "vs_baseline": round((n_paths / lane_s)
-                             / (n_paths / host_s), 2),
+        "vs_baseline": round(host_med / lane_med, 2),
         "detail": {
             "paths": n_paths,
-            "lane_wall_s": round(lane_s, 2),
-            "host_wall_s": round(host_s, 2),
+            "lane_wall_s": _spread(lane_walls),
+            "host_wall_s": _spread(host_walls),
             "device_forks": stats.get("forks"),
             "device_steps": stats.get("device_steps"),
             "windows": stats.get("windows"),
         },
     }
+
+
+def _analyze_fixture(path, timeout, tx_count, tpu_lanes):
+    """One full analysis (all detectors) of a precompiled fixture —
+    the config-2/3 measurement core (BASELINE.md table; the .sol
+    sources named there need solc, absent in this image, so the
+    nearest precompiled testdata fixtures stand in)."""
+    from types import SimpleNamespace
+
+    from mythril_tpu.models import pruner
+    from mythril_tpu.support.model import SCREEN_STATS
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+
+    reset_analysis_state()
+    ss = SolverStatistics()
+    ss.enabled = True
+    q0, t0s = ss.query_count, ss.solver_time
+    p0 = dict(pruner.STATS)
+    s0 = dict(SCREEN_STATS)
+    disassembler = MythrilDisassembler(eth=None)
+    address, _ = disassembler.load_from_bytecode(
+        path.read_text().strip(), bin_runtime=True)
+    cmd_args = SimpleNamespace(
+        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=1.0 if tpu_lanes else None,
+        unconstrained_storage=False, parallel_solving=False,
+        call_depth_limit=3, disable_dependency_pruning=False,
+        custom_modules_directory="", solver_log=None,
+        transaction_sequences=None, tpu_lanes=tpu_lanes,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address)
+    t0 = time.perf_counter()
+    report = analyzer.fire_lasers(modules=None,
+                                  transaction_count=tx_count)
+    wall = time.perf_counter() - t0
+    return {
+        "wall_s": round(wall, 2),
+        "issues": len(report.sorted_issues()),
+        "solver_queries": ss.query_count - q0,
+        "solver_s": round(ss.solver_time - t0s, 1),
+        "interval_screened": pruner.STATS["screened"] - p0["screened"],
+        "interval_pruned": pruner.STATS["pruned"] - p0["pruned"],
+        "device_screened": pruner.STATS["device_screened"]
+        - p0["device_screened"],
+        "queries_screened": SCREEN_STATS["screened"] - s0["screened"],
+        "queries_proved_unsat": SCREEN_STATS["proved_unsat"]
+        - s0["proved_unsat"],
+    }
+
+
+def bench_configs():
+    """BASELINE.md configs 2-3 (stand-in fixtures, solc absent):
+    config 2 = token-style contract, -t 2, 256 lanes;
+    config 3 = integer-overflow contract, -t 3, 4096 lanes with the
+    interval pruner engaged (prune counts vs solver queries)."""
+    from pathlib import Path
+
+    from mythril_tpu.laser import lane_engine
+
+    inputs = Path(os.environ.get(
+        "BENCH_FIXTURES", "/root/reference/tests/testdata/inputs"))
+    out = []
+    if not inputs.exists():
+        return out  # no fixture corpus on this machine: skip configs
+    for name, fixture, txs, lanes in (
+        ("config2 token -t2 256 lanes", "metacoin.sol.o", 2, 256),
+        ("config3 overflow -t3 4096 lanes + pruner",
+         "overflow.sol.o", 3, 4096),
+    ):
+        path = inputs / fixture
+        for bucket in (16, lanes):
+            lane_engine.warm_variant(lanes, 1024, {}, 48, 8192,
+                                     seed_bucket=bucket, block=True)
+        host = _analyze_fixture(path, 120, txs, 0)
+        lane = _analyze_fixture(path, 120, txs, lanes)
+        out.append({
+            "metric": name,
+            "value": lane["wall_s"],
+            "unit": "s",
+            "vs_baseline": round(host["wall_s"]
+                                 / max(lane["wall_s"], 1e-9), 2),
+            "detail": {"host": host, "lane": lane,
+                       "fixture": fixture,
+                       "issues_equal":
+                       host["issues"] == lane["issues"]},
+        })
+    return out
 
 
 def _enable_compile_cache():
@@ -233,7 +355,7 @@ def main():
     # host paths/sec: states-per-second over the mean path length
     host_paths_per_s = host_states_per_s / avg_len
 
-    dev_paths_per_s, dev_instr_per_s = bench_device(code)
+    dev_paths_per_s, dev_instr_per_s, dev_spread = bench_device(code)
 
     concrete = {
         "metric": "concrete paths/sec/chip (device window only)",
@@ -242,6 +364,7 @@ def main():
         "vs_baseline": round(dev_paths_per_s / max(host_paths_per_s, 1e-9), 1),
         "detail": {
             "device_lane_instr_per_s": round(dev_instr_per_s, 1),
+            "device_window_s": dev_spread,
             "host_engine_states_per_s": round(host_states_per_s, 1),
             "host_engine_states": states,
             "host_engine_elapsed_s": round(host_elapsed, 2),
@@ -257,6 +380,10 @@ def main():
     symbolic["detail"]["concrete_window_paths_per_s"] = round(
         dev_paths_per_s, 1)
     print(json.dumps(symbolic), flush=True)
+
+    if os.environ.get("BENCH_CONFIGS", "1") != "0":
+        for line in bench_configs():
+            print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

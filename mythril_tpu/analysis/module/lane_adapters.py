@@ -208,9 +208,20 @@ class ArbitraryStorageAdapter(LaneAdapter):
     """Device SSTOREs always have concrete keys (symbolic keys park);
     the module's probe constraint `key == 324345425435` is unsatisfiable
     for a concrete key unless the contract literally writes that slot —
-    a documented, astronomically-unlikely deviation."""
+    a documented, astronomically-unlikely deviation (PARITY.md)."""
 
     lifted_hooks = frozenset({"SSTORE"})
+    _logged_deviation = False
+
+    def on_sstore(self, value, site):
+        if not ArbitraryStorageAdapter._logged_deviation:
+            ArbitraryStorageAdapter._logged_deviation = True
+            log.info(
+                "lane-mode deviation active: ArbitraryStorage probes "
+                "device-executed concrete-key SSTOREs with an "
+                "unsatisfiable constraint (host parity except a "
+                "contract writing slot 324345425435; see PARITY.md)")
+        return super().on_sstore(value, site)
 
 
 class StateChangeAdapter(LaneAdapter):

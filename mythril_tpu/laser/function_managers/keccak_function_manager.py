@@ -151,4 +151,8 @@ class KeccakFunctionManager:
         )
 
 
-keccak_function_manager = KeccakFunctionManager()
+from ...support.run_context import SwappableProxy  # noqa: E402
+
+# per-run axiom state behind a stable handle (SURVEY §5 parallel-safe
+# contexts; support/run_context.RunContext.activate swaps it)
+keccak_function_manager = SwappableProxy(KeccakFunctionManager())

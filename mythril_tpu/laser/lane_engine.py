@@ -235,14 +235,6 @@ import jax  # noqa: E402  (this module is only imported on the lane path)
 import jax.numpy as jnp  # noqa: E402
 
 
-N_MISC = 4  # dlog_count, pclog_count, status, steps
-
-#: floor bucket for the fused per-window log pull: every window pulls
-#: all lanes' first DFLOOR/PFLOOR log records in the same dispatch as
-#: the run itself; the (rare) window where some lane logged more does
-#: one escalation gather at the cap shape
-DFLOOR = 8
-PFLOOR = 8
 
 
 def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
@@ -259,11 +251,12 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
         return plane.at[idx].set(0, mode="drop")
 
     # i32 pack: [sbase, cd_size, cd_sym, cd_size_sid, pc, sp, msize,
-    #            env_sid…]
+    #            group, env_sid…]
     sbase, cd_size, cd_sym, cd_size_sid = (
         i32p[:, 0], i32p[:, 1], i32p[:, 2], i32p[:, 3])
-    pc, sp, msize = i32p[:, 4], i32p[:, 5], i32p[:, 6]
-    env_sid = i32p[:, 7:7 + n_env]
+    pc, sp, msize, group = (i32p[:, 4], i32p[:, 5], i32p[:, 6],
+                            i32p[:, 7])
+    env_sid = i32p[:, 8:8 + n_env]
     # u32 pack: [gas_limit, env limbs…]
     gas_limit = u32p[:, 0]
     env = u32p[:, 1:].reshape(k, n_env, bv256.NLIMBS)
@@ -272,6 +265,7 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
         pc=st.pc.at[idx].set(pc, mode="drop"),
         sp=st.sp.at[idx].set(sp, mode="drop"),
         depth=zero(st.depth),
+        group=st.group.at[idx].set(group, mode="drop"),
         ssid=st.ssid.at[idx].set(stack_s, mode="drop"),
         stack=st.stack.at[idx].set(
             stack_v.reshape(k, st.stack.shape[1], bv256.NLIMBS),
@@ -290,7 +284,6 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
         max_gas=zero(st.max_gas),
         steps=zero(st.steps),
         dlog_count=zero(st.dlog_count),
-        pclog_count=zero(st.pclog_count),
         fentry=st.fentry.at[idx].set(-1, mode="drop"),
         last_jump=st.last_jump.at[idx].set(-1, mode="drop"),
         status=st.status.at[idx].set(Status.RUNNING, mode="drop"),
@@ -384,70 +377,187 @@ def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
 
 
 def _counts_core(st: SymLaneState):
-    """Per-lane counters + scalars (drives the sized log/retire
-    gathers)."""
+    """Per-lane counters + scalars."""
     misc = jnp.stack(
-        [st.dlog_count, st.pclog_count, st.status, st.steps,
+        [st.dlog_count, st.status, st.steps,
          st.sp, st.scount, st.mlog_count, st.msize], axis=1)
     scal = jnp.stack([st.flog_count, st.free_count])
     return misc, scal
 
 
-def _gather_logs_core(st: SymLaneState, rc, k, dmax: int, pmax: int):
+#: unique-record / fork-row budgets of the fused window pull (escalate
+#: to a full gather in the rare window that exceeds them)
+URB = 512
+FB = 512
+_DEDUP_H = 4096  # dedup hash-table cells
+
+_SSTORE_BYTE = _OPB["SSTORE"]
+
+
+def _dedup_canon(st: SymLaneState, d_recs: int):
+    """Canonicalize this window's deferred records ON DEVICE: lockstep
+    sibling lanes recompute identical records (same seed cohort, op,
+    pc, step, operands), and draining one instance per distinct term —
+    instead of one per lane — is what makes the drain cost scale with
+    the tree's distinct work rather than the lane count (the round-2
+    symbolic bench spent 112 s of 177 s re-walking duplicate records).
+
+    Processed in GLOBAL STEP order (one record per lane per step) so an
+    argument referencing an ancestor lane's earlier record is already
+    canonical when its referrer is hashed — content-equal records then
+    compare equal on their canonical argument sids. Hash collisions
+    fall back to self (less dedup, never wrong); SSTORE taint-sink
+    records keep per-lane identity by construction. Returns the
+    arg-remapped dlog_sid plane and the (N, R) canonical-pid plane."""
     from jax import lax
 
-    dlog = jnp.concatenate([
-        st.dlog_op[rc, :dmax, None], st.dlog_pc[rc, :dmax, None],
-        st.dlog_step[rc, :dmax, None], st.dlog_fentry[rc, :dmax, None],
-        st.dlog_sid[rc, :dmax],
-        lax.bitcast_convert_type(st.dlog_val[rc, :dmax], jnp.int32)
-        .reshape(k, dmax, 3 * bv256.NLIMBS),
-    ], axis=2)
-    pclog = jnp.concatenate([
-        st.pclog_sid[rc, :pmax, None], st.pclog_neg[rc, :pmax, None],
-        st.pclog_pc[rc, :pmax, None], st.pclog_step[rc, :pmax, None],
-        st.pclog_fentry[rc, :pmax, None],
-        lax.bitcast_convert_type(st.pclog_gmin[rc, :pmax],
-                                 jnp.int32)[..., None],
-        lax.bitcast_convert_type(st.pclog_gmax[rc, :pmax],
-                                 jnp.int32)[..., None],
-    ], axis=2)
-    flog = jnp.stack(
-        [st.flog_parent, st.flog_child, st.flog_step], axis=1)
-    return dlog, pclog, flog
+    n = st.pc.shape[0]
+    lanes = jnp.arange(n)
+    intmax = jnp.iinfo(jnp.int32).max
+    live_all = jnp.arange(d_recs)[None, :] < st.dlog_count[:, None]
+    any_rec = jnp.any(live_all)
+    lo = jnp.min(jnp.where(live_all, st.dlog_step, intmax))
+    hi = jnp.max(jnp.where(live_all, st.dlog_step, -1))
+
+    def round_s(s, carry):
+        dlog_sid, canon_pid = carry
+        match = live_all & (st.dlog_step == s)
+        has = jnp.any(match, axis=1)
+        slot = jnp.argmax(match, axis=1)
+
+        def take(plane):
+            return plane[lanes, slot]
+
+        sids = dlog_sid[lanes, slot]
+        negm = sids < 0
+        idx = jnp.where(negm, -sids - 1, 0)
+        mapped = canon_pid[idx // d_recs, idx % d_recs]
+        sids = jnp.where(negm, mapped, sids)
+        dlog_sid = dlog_sid.at[lanes, slot].set(
+            jnp.where(has[:, None], sids, dlog_sid[lanes, slot]))
+        op = take(st.dlog_op)
+        pc = take(st.dlog_pc)
+        fen = take(st.dlog_fentry)
+        grp = st.group
+        vals = st.dlog_val[lanes, slot].reshape(n, -1)
+        h = jnp.zeros(n, jnp.uint32)
+        for f in (grp, op, pc, fen, sids[:, 0], sids[:, 1],
+                  sids[:, 2]):
+            h = h * jnp.uint32(0x9E3779B1) + \
+                lax.bitcast_convert_type(f, jnp.uint32)
+        for c in range(vals.shape[1]):
+            h = h * jnp.uint32(0x9E3779B1) + vals[:, c]
+        cand = has & (op != _SSTORE_BYTE)
+        bucket = jnp.where(cand, (h % _DEDUP_H).astype(jnp.int32),
+                           _DEDUP_H)
+        win = jnp.full((_DEDUP_H,), intmax, jnp.int32)
+        win = win.at[bucket].min(
+            jnp.where(cand, lanes, intmax).astype(jnp.int32),
+            mode="drop")
+        w = jnp.clip(win[jnp.clip(bucket, 0, _DEDUP_H - 1)], 0, n - 1)
+        eq = (
+            cand & has[w] & (op == op[w]) & (pc == pc[w])
+            & (fen == fen[w]) & (grp == grp[w])
+            & jnp.all(sids == sids[w], axis=1)
+            & jnp.all(vals == vals[w], axis=1)
+        )
+        canon_lane = jnp.where(eq, w, lanes)
+        canon_slot = jnp.where(eq, slot[w], slot)
+        pid = -(canon_lane * d_recs + canon_slot + 1)
+        canon_pid = canon_pid.at[lanes, slot].set(
+            jnp.where(has, pid, canon_pid[lanes, slot]))
+        return dlog_sid, canon_pid
+
+    canon0 = jnp.zeros((n, d_recs), jnp.int32)
+    dlog_sid, canon_pid = lax.fori_loop(
+        jnp.where(any_rec, lo, 0), jnp.where(any_rec, hi + 1, 0),
+        round_s, (st.dlog_sid, canon0))
+    return dlog_sid, canon_pid
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _gather_logs_rows(st: SymLaneState, act, dmax: int, pmax: int):
-    """Escalation gather: log rows of selected lanes, column-clipped to
-    the busiest lane's record count — only for the rare window whose
-    records exceed the fused pull's floor bucket."""
-    rc = jnp.clip(act, 0, st.pc.shape[0] - 1)
-    return _gather_logs_core(st, rc, act.shape[0], dmax, pmax)
+def _canon_remap(st: SymLaneState, canon_pid, d_recs: int
+                 ) -> SymLaneState:
+    """Rewrite this window's provisional sids in the persistent planes
+    to their canonical pids (the host only builds/publishes canonical
+    records)."""
+
+    def remap(plane):
+        negm = plane < 0
+        idx = jnp.where(negm, -plane - 1, 0)
+        mapped = canon_pid[idx // d_recs, idx % d_recs]
+        return jnp.where(negm, mapped, plane)
+
+    return st._replace(
+        ssid=remap(st.ssid),
+        sval_sid=remap(st.sval_sid),
+        mlog_sid=remap(st.mlog_sid),
+        flog_sid=remap(st.flog_sid),
+    )
 
 
-def _unpack_logs(pulled):
-    """Host views over the packed log gather, keyed like per-field
-    arrays (row index = position in the act list)."""
-    dlog, pclog, flog = [np.asarray(x) for x in pulled]
-    k, dmax = dlog.shape[0], dlog.shape[1]
-    h = {
-        "dlog_op": dlog[:, :, 0], "dlog_pc": dlog[:, :, 1],
-        "dlog_step": dlog[:, :, 2], "dlog_fentry": dlog[:, :, 3],
-        "dlog_sid": dlog[:, :, 4:7],
-        "dlog_val": np.ascontiguousarray(dlog[:, :, 7:])
-        .view(np.uint32).reshape(k, dmax, 3, bv256.NLIMBS),
-        "pclog_sid": pclog[:, :, 0], "pclog_neg": pclog[:, :, 1],
-        "pclog_pc": pclog[:, :, 2], "pclog_step": pclog[:, :, 3],
-        "pclog_fentry": pclog[:, :, 4],
-        "pclog_gmin": np.ascontiguousarray(pclog[:, :, 5])
-        .view(np.uint32).reshape(k, -1),
-        "pclog_gmax": np.ascontiguousarray(pclog[:, :, 6])
-        .view(np.uint32).reshape(k, -1),
-        "flog_parent": flog[:, 0], "flog_child": flog[:, 1],
-        "flog_step": flog[:, 2],
-    }
-    return h
+def _unique_table(st: SymLaneState, canon_pid, d_recs: int, urb: int):
+    """Compact the canonical records into an (urb, 9+24) i32 table:
+    [lane, slot, op, pc, step, fentry, sid0..2, vals]; rows beyond the
+    count are padding. Also returns the count (host escalates when it
+    exceeds urb)."""
+    from jax import lax
+
+    n = st.pc.shape[0]
+    live = jnp.arange(d_recs)[None, :] < st.dlog_count[:, None]
+    self_pid = -(jnp.arange(n)[:, None] * d_recs
+                 + jnp.arange(d_recs)[None, :] + 1)
+    is_canon = (live & (canon_pid == self_pid)).reshape(-1)
+    order = jnp.cumsum(is_canon) - 1
+    ucount = jnp.sum(is_canon.astype(jnp.int32))
+    rows = jnp.full((urb,), 0, jnp.int32)
+    rows = rows.at[jnp.where(is_canon, order, urb)].set(
+        jnp.arange(n * d_recs), mode="drop")
+    l, sl = rows // d_recs, rows % d_recs
+    tab = jnp.concatenate([
+        l[:, None], sl[:, None], st.dlog_op[l, sl][:, None],
+        st.dlog_pc[l, sl][:, None], st.dlog_step[l, sl][:, None],
+        st.dlog_fentry[l, sl][:, None], st.dlog_sid[l, sl],
+        lax.bitcast_convert_type(st.dlog_val[l, sl], jnp.int32)
+        .reshape(urb, 3 * bv256.NLIMBS),
+    ], axis=1)
+    return tab, ucount
+
+
+def _fork_table(st: SymLaneState, fb: int):
+    """First fb fork rows as an (fb, 9) i32 table: [parent, child,
+    step, pc, sid, gmin, gmax, fentry, dest]."""
+    from jax import lax
+
+    r = jnp.arange(fb)
+    return jnp.stack([
+        st.flog_parent[r], st.flog_child[r], st.flog_step[r],
+        st.flog_pc[r], st.flog_sid[r],
+        lax.bitcast_convert_type(st.flog_gmin[r], jnp.int32),
+        lax.bitcast_convert_type(st.flog_gmax[r], jnp.int32),
+        st.flog_fentry[r], st.flog_dest[r],
+    ], axis=1)
+
+
+@jax.jit
+def _unique_table_big(st: SymLaneState):
+    """Escalation: recompute the canonical set (idempotent — the sid
+    planes are already canonical) and pull it at the big budget, for
+    the rare window whose distinct-record count exceeds URB. The
+    budget scales with the lane count (cross-seed-group records never
+    dedup, so a big seed bucket can mint ~4 distinct records per lane
+    in one window); beyond it the explore raises and the sweep reroutes
+    the batch to the host interpreter (svm._lane_engine_sweep's
+    fallback) — degraded, never wrong."""
+    d_recs = st.dlog_op.shape[1]
+    n = st.pc.shape[0]
+    urb = min(n * d_recs, max(4096, 8 * n))
+    _, canon_pid = _dedup_canon(st, d_recs)
+    return _unique_table(st, canon_pid, d_recs, urb)
+
+
+@jax.jit
+def _gather_full_flog(st: SymLaneState):
+    return _fork_table(st, st.flog_parent.shape[0])
 
 
 def _remap_reset_core(st: SymLaneState, prov_arr) -> SymLaneState:
@@ -475,7 +585,6 @@ def _remap_reset_core(st: SymLaneState, prov_arr) -> SymLaneState:
         sval_sid=remap(st.sval_sid),
         mlog_sid=remap(st.mlog_sid),
         dlog_count=jnp.zeros_like(st.dlog_count),
-        pclog_count=jnp.zeros_like(st.pclog_count),
         flog_count=jnp.zeros_like(st.flog_count),
     )
 
@@ -506,34 +615,31 @@ def _unpack_i32_sections(buf, sections):
     return out
 
 
-def _seed_sections(n, k, n_env, n_depth, d_recs, midpath):
+def _seed_sections(n, k, n_env, n_depth, d_recs):
     """Layout of the packed per-window i32 buffer (host+device agree).
     The kill section is lane-count-sized so a window can never overflow
     it — a capped bucket would let a dead-but-running lane's slot be
-    re-seeded before its deferred kill lands."""
-    sec = [
+    re-seeded before its deferred kill lands. One layout serves fresh
+    AND mid-path seeds (fresh rows carry zero stack/memory sections):
+    a second jit variant costs ~25 s of compile on the tunneled
+    backend, the extra padding costs ~40 ms per window."""
+    return [
         ("idx", (k,), jnp.int32),
-        ("i32p", (k, 7 + n_env), jnp.int32),
+        ("i32p", (k, 8 + n_env), jnp.int32),
         ("u32p", (k, 1 + n_env * bv256.NLIMBS), jnp.uint32),
         ("fs", (n,), jnp.int32),
         ("fcount", (), jnp.int32),
         ("prov", (n, d_recs), jnp.int32),
         ("kill", (n,), jnp.int32),
+        ("stack_v", (k, n_depth * bv256.NLIMBS), jnp.uint32),
+        ("stack_s", (k, n_depth), jnp.int32),
     ]
-    if midpath:
-        sec += [
-            ("stack_v", (k, n_depth * bv256.NLIMBS), jnp.uint32),
-            ("stack_s", (k, n_depth), jnp.int32),
-        ]
-    return sec
 
 
 @functools.partial(jax.jit, donate_argnums=(0,),
-                   static_argnums=tuple(range(6, 12)))
+                   static_argnums=tuple(range(6, 9)))
 def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
-                 taint_table, window: int, k: int,
-                 midpath: bool, dfloor: int, pfloor: int,
-                 budget: int):
+                 taint_table, window: int, k: int, budget: int):
     """The whole per-window device work in ONE dispatch with TWO packed
     host->device buffers — on a tunneled backend every dispatch is a
     full round trip and every input array is a separately-latencied
@@ -543,16 +649,16 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     1. remap the previous window's provisional sids, reset the logs,
        and kill lanes the host found trivially-false at the last drain;
     2. seed this window's k entries from the packed buffers (fresh
-       tx-entry seeds carry no stack/memory image — their planes are
-       zero-filled on device; midpath=True adds the spill/refill
-       sections);
+       tx-entry seeds carry zero stack/memory sections);
     3. run the window;
-    4. select up to RCAP parked lanes whose rows fit the retire column
+    4. canonicalize the window's deferred records (_dedup_canon) and
+       rewrite the persistent sid planes to canonical pids;
+    5. select up to RCAP parked lanes whose rows fit the retire column
        floors, gather their rows, and mark them DEAD (the host gets
        back lane indices in ridx; over-budget/over-floor lanes stay
        NEEDS_HOST for the escalation dispatch);
-    5. return counters and all lanes' log rows clipped to the floor
-       bucket (one escalation gather in the rare over-floor window).
+    6. return counters, the canonical-record table, and the fork
+       table (one escalation gather in the rare over-budget window).
     """
     from jax import lax
 
@@ -562,21 +668,13 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     n_depth = st.stack.shape[1]
     mem_cap = st.memory.shape[1]
     d_recs = st.dlog_op.shape[1]
-    sec = _seed_sections(n, k, n_env, n_depth, d_recs, midpath)
+    sec = _seed_sections(n, k, n_env, n_depth, d_recs)
     a = _unpack_i32_sections(i32buf, sec)
-    if midpath:
-        stack_v, stack_s = a["stack_v"], a["stack_s"]
-    else:
-        stack_v = jnp.zeros((k, n_depth * bv256.NLIMBS), jnp.uint32)
-        stack_s = jnp.zeros((k, n_depth), jnp.int32)
+    stack_v, stack_s = a["stack_v"], a["stack_s"]
     u8p = u8buf[:k * cap].reshape(k, cap)
-    if midpath:
-        mem_v = u8buf[k * cap:k * (cap + mem_cap)].reshape(k, mem_cap)
-        mem_k = u8buf[k * (cap + mem_cap):
-                      k * (cap + 2 * mem_cap)].reshape(k, mem_cap)
-    else:
-        mem_v = jnp.zeros((k, mem_cap), jnp.uint8)
-        mem_k = jnp.zeros((k, mem_cap), jnp.uint8)
+    mem_v = u8buf[k * cap:k * (cap + mem_cap)].reshape(k, mem_cap)
+    mem_k = u8buf[k * (cap + mem_cap):
+                  k * (cap + 2 * mem_cap)].reshape(k, mem_cap)
 
     st = _remap_reset_core(st, a["prov"])
     st = st._replace(status=st.status.at[a["kill"]].set(
@@ -586,7 +684,12 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
                         a["fcount"])
     st = symstep.sym_run(cc, st, window, exec_table, taint_table)
 
-    # 4. in-dispatch fast retire
+    # 4. canonicalize records; planes reference canonical pids only
+    dlog_sid2, canon_pid = _dedup_canon(st, d_recs)
+    st = st._replace(dlog_sid=dlog_sid2)
+    st = _canon_remap(st, canon_pid, d_recs)
+
+    # 5. in-dispatch fast retire
     dstack, dmem, dmlog, dslot = RETIRE_FLOORS
     rcap = min(RCAP, n)
     parked = (st.status == Status.NEEDS_HOST) | (
@@ -607,8 +710,11 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     st = st._replace(status=st.status.at[ridx].set(DEAD, mode="drop"))
 
     misc, scal = _counts_core(st)
-    logs = _gather_logs_core(st, jnp.arange(n), n, dfloor, pfloor)
-    return st, (misc, scal) + logs + (ridx,) + rows
+    utab, ucount = _unique_table(st, canon_pid, d_recs, min(URB,
+                                                           n * d_recs))
+    ftab = _fork_table(st, min(FB, n))
+    scal = jnp.concatenate([scal, ucount[None]])
+    return st, (misc, scal, utab, ftab, ridx) + rows
 
 
 def _limbs_int(limbs) -> int:
@@ -728,11 +834,11 @@ _WARM_LOCK = None
 
 
 def _variant_key(n_lanes: int, code_len: int, lane_kwargs: dict,
-                 window: int, midpath: bool) -> tuple:
+                 window: int, seed_bucket: int) -> tuple:
     from ..ops.stepper import _code_bucket
 
     return (n_lanes, _code_bucket(code_len),
-            tuple(sorted(lane_kwargs.items())), window, midpath)
+            tuple(sorted(lane_kwargs.items())), window, seed_bucket)
 
 
 @functools.lru_cache(maxsize=1)
@@ -743,7 +849,8 @@ def _tunneled_backend() -> bool:
 
 
 def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
-              window: int, step_budget: int, midpath: bool) -> None:
+              window: int, step_budget: int,
+              seed_bucket: int = 16) -> None:
     """Compile one window-dispatch variant by running an all-dead
     window of the exact production shapes, plus the escalation gathers
     that variant can fall back to mid-run."""
@@ -754,34 +861,18 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
     st = eng._acquire_state()
     # dummy code at the bucket length: shared across warms of the bucket
     cc = _compiled_code(b"\x00" * _code_bucket(max(code_len, 1)), ())
-    i32buf, u8buf, (k, _) = eng._pack_window(
+    big = seed_bucket > min(16, n_lanes)
+    i32buf, u8buf, k = eng._pack_window(
         [], [None] * n_lanes, list(range(n_lanes)), [],
-        int(st.calldata.shape[1]))
-    if midpath:
-        # splice in the (all-zero) midpath sections the layout adds
-        n_depth = eng.lane_kwargs.get("stack_depth", 64)
-        mem_cap = eng.lane_kwargs.get("memory_bytes", 4096)
-        i32buf = jnp.asarray(np.concatenate([
-            np.asarray(i32buf),
-            np.zeros(k * (n_depth * bv256.NLIMBS + n_depth), np.int32),
-        ]))
-        u8buf = jnp.asarray(np.concatenate([
-            np.asarray(u8buf), np.zeros(2 * k * mem_cap, np.uint8)]))
+        int(st.calldata.shape[1]), big=big)
     st, out = _window_exec(
         st, cc, i32buf, u8buf, eng.exec_table, eng.taint_table,
-        window, k, midpath, DFLOOR, PFLOOR, step_budget)
+        window, k, step_budget)
     jax.block_until_ready(out)
-    if not midpath:
+    if not big:
         # escalation variants this engine config can hit mid-explore
-        lk = eng.lane_kwargs
-        d_recs = lk.get("dlog_records", 64)
-        p_recs = lk.get("pc_records", 64)
-        act = jnp.zeros(_coarse_bucket(1, n_lanes, min(64, n_lanes)),
-                        jnp.int32)
-        for dmax, pmax in ((d_recs, PFLOOR), (DFLOOR, p_recs),
-                           (d_recs, p_recs)):
-            jax.block_until_ready(
-                _gather_logs_rows(st, act, dmax, pmax))
+        jax.block_until_ready(_unique_table_big(st))
+        jax.block_until_ready(_gather_full_flog(st))
         ridx = jnp.full(_coarse_bucket(1, n_lanes, min(64, n_lanes)),
                         n_lanes, jnp.int32)
         st, rows = _retire_rows(st, ridx, 16, 512, 8, 8)
@@ -791,7 +882,8 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
 
 def warm_variant(n_lanes: int, code_len: int, lane_kwargs: dict,
                  window: int, step_budget: int,
-                 midpath: bool = False) -> bool:
+                 seed_bucket: int = 16,
+                 block: bool = False) -> bool:
     """True when the (shape-)variant of the fused window dispatch is
     compiled. On a tunneled backend a cold variant kicks off a
     BACKGROUND compile and returns False — the caller keeps the work on
@@ -804,7 +896,8 @@ def warm_variant(n_lanes: int, code_len: int, lane_kwargs: dict,
 
     if _WARM_LOCK is None:
         _WARM_LOCK = threading.Lock()
-    key = _variant_key(n_lanes, code_len, lane_kwargs, window, midpath)
+    key = _variant_key(n_lanes, code_len, lane_kwargs, window,
+                       seed_bucket)
     with _WARM_LOCK:
         state = _WARM.get(key)
         if state == "ready":
@@ -816,14 +909,14 @@ def warm_variant(n_lanes: int, code_len: int, lane_kwargs: dict,
     def _compile():
         try:
             _warm_one(n_lanes, code_len, lane_kwargs, window,
-                      step_budget, midpath)
+                      step_budget, seed_bucket)
         except Exception as e:  # pragma: no cover - warmup best-effort
             log.debug("lane warmup failed: %s", e)
         finally:
             with _WARM_LOCK:
                 _WARM[key] = "ready"  # worst case: sweep pays compile
 
-    if _tunneled_backend():
+    if _tunneled_backend() and not block:
         # ONE sequential worker: concurrent variant compiles would
         # contend for the tunnel and both arrive late
         with _WARM_LOCK:
@@ -918,6 +1011,7 @@ class LaneEngine:
         # NEXT window's fused dispatch, so retired-row resolution (_obj)
         # reads this map directly in the meantime
         self._prov: Dict[Tuple[int, int], int] = {}
+        self._group_seq = 0
         self._func_names: Dict[int, str] = {}
         # repeated CALLDATALOADs at the same offset across lanes resolve
         # to the same word term; building it once matters (32 If+select
@@ -998,6 +1092,7 @@ class LaneEngine:
         )
 
         gas0_min, gas0_max = ms.min_gas_used, ms.max_gas_used
+        self._group_seq += 1
         dev_limit = max(int(ms.gas_limit) - int(gas0_min), 0) \
             if isinstance(ms.gas_limit, int) else 0xFFFFFFF
 
@@ -1077,6 +1172,7 @@ class LaneEngine:
                 mem_k[key] = symstep.KIND_CONC_WORD
 
         return ctx, dict(
+            group=self._group_seq,
             sbase=0 if virgin_zero else 1,
             calldata=cd_buf, cd_size=cd_size, cd_sym=cd_sym,
             cd_size_sid=cd_size_sid, env=env_vals, env_sid=env_sids,
@@ -1086,7 +1182,7 @@ class LaneEngine:
         )
 
     def _pack_window(self, entries, ctxs: List[Optional[LaneCtx]],
-                     free, kill, calldata_cap: int):
+                     free, kill, calldata_cap: int, big: bool = False):
         """Pack EVERYTHING the next window dispatch needs from the host
         into two flat buffers (one i32, one u8): seed rows, free-slot
         stack, the previous drain's provisional-sid resolutions, and
@@ -1106,17 +1202,17 @@ class LaneEngine:
         n_depth = self.lane_kwargs.get("stack_depth", 64)
         mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
         d_recs = self.lane_kwargs.get("dlog_records", 64)
-        # ALWAYS the same bucket, even with zero entries: a second
-        # compiled variant of the window dispatch costs far more than a
-        # lifetime of 10 KB all-padding seed sections (explore caps
-        # entries per window to this bucket)
-        k = min(16, n)
+        # two seed buckets only: the small one covers the common
+        # trickle (always compiled — a second jit variant costs far
+        # more than all-padding seed sections); the full-width one
+        # drains seed floods in one window. explore() only requests
+        # `big` once that variant is warm.
+        k = n if big else min(16, n)
         assert len(lanes) <= k
-        midpath = any(s["pc"] or s["sp"] or s["msize"] for s in specs)
 
         idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
         idx[: len(lanes)] = lanes
-        i32p = np.zeros((k, 7 + n_env), np.int32)
+        i32p = np.zeros((k, 8 + n_env), np.int32)
         u32p = np.zeros((k, 1 + n_env * bv256.NLIMBS), np.uint32)
         u8p = np.zeros((k, calldata_cap), np.uint8)
         stack_v = np.zeros((k, n_depth * bv256.NLIMBS), np.uint32)
@@ -1131,7 +1227,8 @@ class LaneEngine:
             i32p[i, 4] = s["pc"]
             i32p[i, 5] = s["sp"]
             i32p[i, 6] = s["msize"]
-            i32p[i, 7:] = s["env_sid"]
+            i32p[i, 7] = s["group"]
+            i32p[i, 8:] = s["env_sid"]
             u32p[i, 0] = s["gas_limit"]
             u32p[i, 1:] = s["env"].reshape(-1)
             u8p[i] = s["calldata"]
@@ -1150,22 +1247,18 @@ class LaneEngine:
 
         parts = [idx, i32p.reshape(-1), u32p.reshape(-1).view(np.int32),
                  fs, np.array([len(free)], np.int32),
-                 prov_arr.reshape(-1), kl]
-        if midpath:
-            parts += [stack_v.reshape(-1).view(np.int32),
-                      stack_s.reshape(-1)]
+                 prov_arr.reshape(-1), kl,
+                 stack_v.reshape(-1).view(np.int32),
+                 stack_s.reshape(-1)]
         i32buf = np.concatenate([np.ascontiguousarray(p, np.int32)
                                  for p in parts])
-        u8_parts = [u8p.reshape(-1)]
-        if midpath:
-            u8_parts += [mem_v.reshape(-1), mem_k.reshape(-1)]
-        u8buf = np.concatenate(u8_parts)
+        u8buf = np.concatenate([u8p.reshape(-1), mem_v.reshape(-1),
+                                mem_k.reshape(-1)])
 
         self.stats["seeded"] += len(entries)
         # mid-path re-entries (the spill/refill path) vs fresh tx seeds
         self.stats["reseeded"] += sum(1 for s in specs if s["pc"])
-        return (jnp.asarray(i32buf), jnp.asarray(u8buf),
-                (k, midpath))
+        return (jnp.asarray(i32buf), jnp.asarray(u8buf), k)
 
     # -- drain ---------------------------------------------------------------
 
@@ -1213,16 +1306,19 @@ class LaneEngine:
                                       alu.to_bitvec(args[0]))
         raise AssertionError(f"unresolvable deferred op {opname}")
 
-    def _jumpi_site_work(self, ctx, lane, cond, step, byte_pc, fentry,
-                         gmin, gmax):
+    def _jumpi_site_work(self, ctx, lane, cond, step, byte_pc,
+                         fentry, gmin, gmax, dest=0):
         """Drain-time detector work for one path-condition record:
         per-lane sink promotions, plus site-firing modules deduped
         across the sibling lanes sharing the record (the interpreter
         fires its pre-hook once per JUMPI execution; issue identity is
-        per (site, condition, path prefix))."""
+        per (site, condition, path prefix)). The site's stack tail is
+        the real pre-hook stack [-2]=condition, [-1]=jump destination
+        (always concrete on device — forks require dest_ok)."""
         prefix = [c for (_, c) in ctx.conds]
         site = _DrainSite(self, ctx, step, byte_pc, fentry, gmin, gmax,
-                          stack_tail=(cond, _bv_val(0)), prefix=prefix)
+                          stack_tail=(cond, _bv_val(dest)),
+                          prefix=prefix)
         for ad in self.adapters:
             anns = ad.on_jumpi(cond, site)
             if anns:
@@ -1236,125 +1332,109 @@ class LaneEngine:
         for ad in self.adapters:
             ad.on_jumpi_site(cond, site)
 
-    def _drain_host(self, h: dict, row_of: Dict[int, int],
-                    counts_h: dict,
+    def _drain_host(self, recs, forks,
                     ctxs: List[Optional[LaneCtx]]
                     ) -> Tuple[Dict[Tuple[int, int], int], List[int]]:
-        """Resolve one window's pulled logs into facade terms; returns
-        (provisional-sid resolutions, dead lanes). Dead lanes are paths
-        whose latest condition folded to false (the jumpi_ handler's
-        trivial-falsity pruning). Pure host work — the provisional
-        remap + log reset ride the NEXT window's fused dispatch."""
+        """Resolve one window's canonical records and fork table into
+        facade terms; returns (provisional-sid resolutions, dead
+        lanes). Pure host work — the provisional remap + log reset
+        ride the NEXT window's fused dispatch.
+
+        recs: [(step, lane, slot, op, pc, fentry, sids(3), vals(3,8))]
+        — one entry per DISTINCT term (device-deduped; `lane` is the
+        canonical instance's lane). forks: [(step, parent, child, pc,
+        sid, gmin, gmax, fentry)]. Events interleave in global step
+        order, so a fork clones its parent's context exactly as
+        accumulated at that step — condition prefixes, sink
+        promotions, and annotations inherit by construction (the
+        interpreter's deepcopy-at-JUMPI semantics)."""
         d_recs = self.lane_kwargs.get("dlog_records", 64)
-        nf = counts_h["flog_count"]
-
         _t_drain_py = time.perf_counter() if PROF_ON else 0.0
-        # 1. fork genealogy (flog is already in step order)
-        for i in range(nf):
-            parent = int(h["flog_parent"][i])
-            child = int(h["flog_child"][i])
-            ctxs[child] = ctxs[parent].clone()
-        self.stats["forks"] += nf
-
-        # 2. deferred records in (step, lane, slot) order. SSTORE rows
-        # are taint-sink records (no term to build); arithmetic rows
-        # fire adapter annotations BEFORE the result term is built so
-        # annotation union propagates exactly as in the interpreter.
-        recs = []
-        counts = h["dlog_count"]
-        for lane in np.nonzero(counts > 0)[0]:
-            lane = int(lane)
-            row = row_of[lane]
-            for k in range(int(counts[lane])):
-                recs.append((int(h["dlog_step"][row, k]), lane, k))
-        recs.sort()
         prov: Dict[Tuple[int, int], int] = {}
-        # lane -> [(step, adapter-id, annotation)] minted this window
-        # from dlog sink records (inherited across forks below)
-        window_promos: Dict[int, list] = {}
-        for step, lane, k in recs:
-            row = row_of[lane]
-            opname = _OPN[int(h["dlog_op"][row, k])]
-            sids = h["dlog_sid"][row, k]
-            vals = h["dlog_val"][row, k]
-            if opname == "SSTORE":
-                value = self._resolve_arg(int(sids[1]), vals[1], prov,
-                                          d_recs)
-                site = _DrainSite(
-                    self, ctxs[lane], step,
-                    int(h["dlog_pc"][row, k]),
-                    int(h["dlog_fentry"][row, k]))
-                for ad in self.adapters:
-                    for ann in ad.on_sstore(alu.to_bitvec(value), site):
-                        window_promos.setdefault(lane, []).append(
-                            (step, id(ad), ann))
-                continue
-            # dedup identical records across lanes: forked paths
-            # recompute the same terms in lockstep, and one resolution
-            # (one shared wrapper — host parity: sibling states share
-            # stack wrappers via MachineStack's shallow copy) serves all
-            key_parts = [opname]
-            for j in range(_ARITY[opname]):
-                sid = int(sids[j])
-                if sid == 0:
-                    key_parts.append(("c", _limbs_int(vals[j])))
-                elif sid > 0:
-                    key_parts.append(("o", sid))
-                else:
-                    idx = -sid - 1
-                    key_parts.append(
-                        ("o", prov[(idx // d_recs, idx % d_recs)]))
-            # SLOAD/CALLDATALOAD resolve against per-seed context
-            if opname in ("SLOAD", "CALLDATALOAD"):
-                key_parts.append(("ctx", id(ctxs[lane].template)))
-            # annotated arithmetic is per-site: two executions at
-            # different pcs must annotate separately (the interpreter
-            # captures a distinct ostate per execution)
-            if opname in self._annot_ops:
-                key_parts.append(("pc", int(h["dlog_pc"][row, k])))
-            key = tuple(key_parts)
-            oid = self._record_memo.get(key)
-            if oid is None:
-                args = [
-                    self._resolve_arg(int(sids[j]), vals[j], prov,
-                                      d_recs)
-                    for j in range(3)
-                ]
-                if opname in self._annot_ops:
-                    site = _DrainSite(
-                        self, ctxs[lane], step,
-                        int(h["dlog_pc"][row, k]),
-                        int(h["dlog_fentry"][row, k]))
-                    cargs = [alu.to_bitvec(x) if not isinstance(x, int)
-                             else _bv_val(x) for x in args[:2]]
-                    for ad in self.adapters:
-                        ad.pre_resolve(opname, cargs, site)
-                    args[:2] = cargs
-                obj = self._resolve_record(ctxs[lane], opname, args)
-                # sids model stack slots: apply MachineStack.append's
-                # coercion (state/machine_state.py — Bool/int pushes
-                # are wrapped into 256-bit BitVecs)
-                if isinstance(obj, Bool):
-                    obj = If(obj, _bv_val(1), _bv_val(0))
-                elif isinstance(obj, int):
-                    obj = _bv_val(obj)
-                oid = self.objects.add(obj)
-                self._record_memo[key] = oid
-            prov[(lane, k)] = oid
-        self.stats["records"] += len(recs)
-
-        # 3. path conditions -> ctx.conds (jumpi_ handler semantics),
-        # with drain-time JUMPI detector work per fork site
         dead: List[int] = []
-        pcounts = h["pclog_count"]
-        for lane in np.nonzero(pcounts > 0)[0]:
-            lane = int(lane)
-            row = row_of[lane]
-            ctx = ctxs[lane]
-            lane_dead = False
-            for j in range(int(pcounts[lane])):
-                sid = int(h["pclog_sid"][row, j])
-                neg = int(h["pclog_neg"][row, j])
+        dead_set: set = set()
+        events = [(r[0], 0, r) for r in recs] \
+            + [(f[0], 1, f) for f in forks]
+        events.sort(key=lambda e: (e[0], e[1]))
+        for _, kind, ev in events:
+            if kind == 0:
+                step, lane, slot, op, pc, fentry, sids, vals = ev
+                opname = _OPN[op]
+                ctx = ctxs[lane]
+                if opname == "SSTORE":
+                    # taint-sink record (never deduped): per-lane
+                    # promotion onto this lane's context
+                    if lane in dead_set:
+                        continue
+                    value = self._resolve_arg(sids[1], vals[1], prov,
+                                              d_recs)
+                    site = _DrainSite(self, ctx, step, pc, fentry)
+                    for ad in self.adapters:
+                        for ann in ad.on_sstore(alu.to_bitvec(value),
+                                                site):
+                            ctx.promos.setdefault(id(ad), []).append(
+                                (step, ann))
+                    continue
+                # cross-WINDOW dedup via the memo (the device already
+                # deduped within the window)
+                key_parts = [opname]
+                for j in range(_ARITY[opname]):
+                    sid = sids[j]
+                    if sid == 0:
+                        key_parts.append(("c", _limbs_int(vals[j])))
+                    elif sid > 0:
+                        key_parts.append(("o", sid))
+                    else:
+                        idx = -sid - 1
+                        key_parts.append(
+                            ("o", prov[(idx // d_recs,
+                                        idx % d_recs)]))
+                # SLOAD/CALLDATALOAD resolve against per-seed context
+                if opname in ("SLOAD", "CALLDATALOAD"):
+                    key_parts.append(("ctx", id(ctx.template)))
+                # annotated arithmetic is per-site AND per-seed: two
+                # executions at different pcs (or from different entry
+                # states) must annotate separately — the interpreter
+                # captures a distinct ostate per execution
+                if opname in self._annot_ops:
+                    key_parts.append(("pc", pc, "ctx",
+                                      id(ctx.template)))
+                key = tuple(key_parts)
+                oid = self._record_memo.get(key)
+                if oid is None:
+                    args = [
+                        self._resolve_arg(sids[j], vals[j], prov,
+                                          d_recs)
+                        for j in range(3)
+                    ]
+                    if opname in self._annot_ops:
+                        site = _DrainSite(self, ctx, step, pc, fentry)
+                        cargs = [alu.to_bitvec(x)
+                                 if not isinstance(x, int)
+                                 else _bv_val(x) for x in args[:2]]
+                        for ad in self.adapters:
+                            ad.pre_resolve(opname, cargs, site)
+                        args[:2] = cargs
+                    obj = self._resolve_record(ctx, opname, args)
+                    # sids model stack slots: apply MachineStack
+                    # .append's coercion (state/machine_state.py)
+                    if isinstance(obj, Bool):
+                        obj = If(obj, _bv_val(1), _bv_val(0))
+                    elif isinstance(obj, int):
+                        obj = _bv_val(obj)
+                    oid = self.objects.add(obj)
+                    self._record_memo[key] = oid
+                prov[(lane, slot)] = oid
+            else:
+                (step, parent, child, pc, sid, gmin, gmax, fentry,
+                 dest) = ev
+                ctx = ctxs[parent]
+                if parent in dead_set:
+                    # descendants of a trivially-false path die with it
+                    ctxs[child] = ctx.clone()
+                    dead_set.add(child)
+                    dead.append(child)
+                    continue
                 if sid > 0:
                     cond = self.objects[sid]
                 else:
@@ -1362,44 +1442,28 @@ class LaneEngine:
                     cond = self.objects[prov[(idx // d_recs,
                                               idx % d_recs)]]
                 if self.adapters:
-                    self._jumpi_site_work(
-                        ctx, lane, cond,
-                        step=int(h["pclog_step"][row, j]),
-                        byte_pc=int(h["pclog_pc"][row, j]),
-                        fentry=int(h["pclog_fentry"][row, j]),
-                        gmin=int(h["pclog_gmin"][row, j]),
-                        gmax=int(h["pclog_gmax"][row, j]),
-                    )
+                    self._jumpi_site_work(ctx, parent, cond, step, pc,
+                                          fentry, gmin, gmax, dest)
+                ctxs[child] = cctx = ctx.clone()
                 if isinstance(cond, Bool):
-                    chosen = simplify(Not(cond)) if neg \
-                        else simplify(cond)
+                    chosen_p = simplify(cond)
+                    chosen_c = simplify(Not(cond))
                 else:
-                    chosen = (cond == 0) if neg else (cond != 0)
-                if chosen.is_false:
-                    lane_dead = True
-                    break
-                ctx.conds.append((int(h["pclog_step"][row, j]), chosen))
-            if lane_dead:
-                dead.append(lane)
+                    chosen_p = cond != 0
+                    chosen_c = cond == 0
+                if chosen_p.is_false:
+                    dead_set.add(parent)
+                    dead.append(parent)
+                else:
+                    ctx.conds.append((step, chosen_p))
+                if chosen_c.is_false:
+                    dead_set.add(child)
+                    dead.append(child)
+                else:
+                    cctx.conds.append((step, chosen_c))
+        self.stats["records"] += len(recs)
+        self.stats["forks"] += len(forks)
         self.stats["dead"] += len(dead)
-
-        # 3b. fork-inherit this window's dlog-sourced promotions (the
-        # child's deferred log is reset at fork, so records minted by
-        # the parent before the fork must flow down); flog is in step
-        # order, so multi-level descent resolves in one pass
-        if window_promos or nf:
-            for i in range(nf):
-                parent = int(h["flog_parent"][i])
-                child = int(h["flog_child"][i])
-                fstep = int(h["flog_step"][i])
-                inherited = [p for p in window_promos.get(parent, ())
-                             if p[0] <= fstep]
-                if inherited:
-                    window_promos.setdefault(child, []).extend(inherited)
-            for lane, plist in window_promos.items():
-                promos = ctxs[lane].promos
-                for step, ad_id, ann in plist:
-                    promos.setdefault(ad_id, []).append((step, ann))
 
         if PROF_ON:
             PROF["drain_py"] = PROF.get("drain_py", 0.0) \
@@ -1565,14 +1629,23 @@ class LaneEngine:
         free = list(range(self.n_lanes - 1, -1, -1))
         results: List[GlobalState] = []
         calldata_cap = int(st.calldata.shape[1])
-        d_recs = int(st.dlog_op.shape[1])
-        p_recs = int(st.pclog_sid.shape[1])
         n = self.n_lanes
         import jax.numpy as jnp
 
         kill: List[int] = []
-        seed_cap = min(16, self.n_lanes)  # one jit variant per layout
+        small = min(16, self.n_lanes)
         while True:
+            # a seed backlog beyond the small bucket drains in ONE
+            # window through the full-width midpath variant — but only
+            # once that variant is compiled (warm_variant kicks a
+            # background compile and the small bucket carries on)
+            seed_cap = small
+            if len(queue) > small and warm_variant(
+                self.n_lanes, len(code_bytes), self.lane_kwargs,
+                self.window, self.step_budget,
+                seed_bucket=self.n_lanes,
+            ):
+                seed_cap = self.n_lanes
             entries = []
             while queue and free and len(entries) < seed_cap:
                 gs = queue.popleft()
@@ -1582,15 +1655,16 @@ class LaneEngine:
                     results.append(gs)  # host handles this entry
                     continue
                 entries.append((free.pop(), gs))
-            i32buf, u8buf, (k, midpath) = self._pack_window(
-                entries, ctxs, free, kill, calldata_cap)
+            i32buf, u8buf, k = self._pack_window(
+                entries, ctxs, free, kill, calldata_cap,
+                big=seed_cap > small)
             n_free_written = len(free)
             _tw = time.perf_counter() if PROF_ON else 0.0
             with _prof("window_exec", sync=lambda: st.pc):
                 st, out = _window_exec(
                     st, cc, i32buf, u8buf, self.exec_table,
-                    self.taint_table, self.window, k, midpath,
-                    DFLOOR, PFLOOR, self.step_budget)
+                    self.taint_table, self.window, k,
+                    self.step_budget)
             # the kill landed at the dispatch's reset phase: only now
             # may the slots be recycled (they enter the free stack the
             # device sees at the NEXT dispatch)
@@ -1601,47 +1675,56 @@ class LaneEngine:
             if PROF_ON:
                 PROF.setdefault("windows", []).append(  # type: ignore
                     (round(time.perf_counter() - _tw, 3), k,
-                     int(midpath), len(code_bytes)))
+                     len(code_bytes)))
             self.stats["windows"] += 1
             with _prof("window_pull"):
-                (misc, scal, dlogf, pclogf, flogf, ridx, r_i32, r_u32,
+                (misc, scal, utab, ftab, ridx, r_i32, r_u32,
                  r_u8) = [np.asarray(x) for x in jax.device_get(out)]
             counts_h = {
-                "dlog_count": misc[:, 0], "pclog_count": misc[:, 1],
-                "status": misc[:, 2], "steps": misc[:, 3],
-                "sp": misc[:, 4], "scount": misc[:, 5],
-                "mlog_count": misc[:, 6], "msize": misc[:, 7],
+                "dlog_count": misc[:, 0], "status": misc[:, 1],
+                "steps": misc[:, 2], "sp": misc[:, 3],
+                "scount": misc[:, 4], "mlog_count": misc[:, 5],
+                "msize": misc[:, 6],
                 "flog_count": int(scal[0]),
                 "free_count": int(scal[1]),
+                "ucount": int(scal[2]),
             }
             self.last_counts = counts_h
-            # floor-bucket logs cover the typical window; escalate with
-            # one extra sized gather when some lane logged past a floor
-            dmax_seen = int(counts_h["dlog_count"].max()) if n else 0
-            pmax_seen = int(counts_h["pclog_count"].max()) if n else 0
-            if dmax_seen > DFLOOR or pmax_seen > PFLOOR:
-                act = np.nonzero(
-                    (counts_h["dlog_count"] > 0)
-                    | (counts_h["pclog_count"] > 0))[0].astype(np.int32)
-                ka = _coarse_bucket(max(len(act), 1), n, min(64, n))
-                act_pad = np.zeros(ka, np.int32)
-                act_pad[: len(act)] = act
-                dmax = _coarse_bucket(max(dmax_seen, 1), d_recs, 8)
-                pmax = _coarse_bucket(max(pmax_seen, 1), p_recs, 8)
+            nf = counts_h["flog_count"]
+            ucount = counts_h["ucount"]
+            if ucount > utab.shape[0]:
+                # rare: more distinct records than the table budget
                 with _prof("logs_escalate"):
-                    h = _unpack_logs(jax.device_get(_gather_logs_rows(
-                        st, jnp.asarray(act_pad), dmax, pmax)))
-                row_of = {int(lane): i for i, lane in enumerate(act)}
-            else:
-                h = _unpack_logs((dlogf, pclogf, flogf))
-                row_of = {lane: lane for lane in range(n)}
-            h["flog_parent"] = flogf[:, 0]
-            h["flog_child"] = flogf[:, 1]
-            h["flog_step"] = flogf[:, 2]
-            h["dlog_count"] = counts_h["dlog_count"]
-            h["pclog_count"] = counts_h["pclog_count"]
-            self._prov, dead = self._drain_host(h, row_of, counts_h,
-                                                ctxs)
+                    utab, uc2 = jax.device_get(_unique_table_big(st))
+                utab = np.asarray(utab)
+                ucount = int(uc2)
+                if ucount > utab.shape[0]:
+                    raise RuntimeError(
+                        f"{ucount} distinct records in one window "
+                        f"exceed the escalation budget")
+            recs = []
+            for i in range(ucount):
+                row = utab[i]
+                recs.append((
+                    int(row[4]), int(row[0]), int(row[1]), int(row[2]),
+                    int(row[3]), int(row[5]),
+                    (int(row[6]), int(row[7]), int(row[8])),
+                    np.ascontiguousarray(row[9:]).view(np.uint32)
+                    .reshape(3, bv256.NLIMBS),
+                ))
+            if nf > ftab.shape[0]:
+                with _prof("flog_escalate"):
+                    ftab = np.asarray(jax.device_get(
+                        _gather_full_flog(st)))
+            forks = []
+            for i in range(nf):
+                r = ftab[i]
+                forks.append((
+                    int(r[2]), int(r[0]), int(r[1]), int(r[3]),
+                    int(r[4]), int(np.uint32(r[5])),
+                    int(np.uint32(r[6])), int(r[7]), int(r[8]),
+                ))
+            self._prov, dead = self._drain_host(recs, forks, ctxs)
             status = counts_h["status"].copy()
             steps = counts_h["steps"]
             # forked children consumed slots from the top (tail) of the

@@ -317,6 +317,12 @@ class LaserEVM:
         # issue-annotation mode diverts them onto states, lifted hooks
         # would lose their issues — keep everything parked instead
         can_lift = not args.use_issue_annotations
+        if not can_lift and args.tpu_lanes:
+            log.info(
+                "lane-mode fallback active: --use-issue-annotations "
+                "diverts drain-fired issues onto states, so detector "
+                "hook lifting is disabled and hooked opcodes park "
+                "host-side (documented in PARITY.md)")
         adapters: List[object] = []
         blocked = set()
         for hook_dict in (self.pre_hooks, self.post_hooks):
@@ -370,14 +376,8 @@ class LaserEVM:
             # route to the device only once its jit variant is compiled
             # (on a tunneled backend the compile runs in a background
             # thread while the host interpreter takes this batch)
-            ready = warm_variant(args.tpu_lanes, len(code), {},
-                                 DEFAULT_WINDOW, DEFAULT_STEP_BUDGET,
-                                 midpath=False)
-            if any(gs.mstate.pc for gs in states):
-                ready = warm_variant(
-                    args.tpu_lanes, len(code), {}, DEFAULT_WINDOW,
-                    DEFAULT_STEP_BUDGET, midpath=True) and ready
-            if not ready:
+            if not warm_variant(args.tpu_lanes, len(code), {},
+                                DEFAULT_WINDOW, DEFAULT_STEP_BUDGET):
                 self.work_list.extend(states)
                 continue
             key = (code, args.tpu_lanes, frozenset(blocked),

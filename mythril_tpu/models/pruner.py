@@ -27,6 +27,12 @@ _device_failures = 0
 _device_skip = 0
 _MAX_SKIP = 256
 
+#: cumulative effectiveness counters (read by bench configs / -v4
+#: diagnostics): items screened through the interval domain, items
+#: pruned by it, and how many ran on the device vs host transfer
+#: functions.
+STATS = {"screened": 0, "pruned": 0, "device_screened": 0}
+
 
 def _device_should_try() -> bool:
     global _device_skip
@@ -62,6 +68,9 @@ def prefilter_world_states(open_states: List) -> List:
         try:
             out = _prefilter_device(open_states)
             _device_succeeded()
+            STATS["screened"] += len(open_states)
+            STATS["pruned"] += len(open_states) - len(out)
+            STATS["device_screened"] += len(open_states)
             return out
         except Exception as e:  # bounded backoff, then retry
             _device_failed(e)
@@ -77,6 +86,8 @@ def prefilter_world_states(open_states: List) -> List:
             dropped += 1
         else:
             out.append(ws)
+    STATS["screened"] += len(open_states)
+    STATS["pruned"] += dropped
     if dropped:
         log.info("interval pre-filter dropped %d open states", dropped)
     return out
@@ -86,6 +97,7 @@ def _screen_interval(items: List, get_constraints) -> List:
     """Shared interval screen: device-batched when large enough (with
     the failure backoff), host transfer functions otherwise. Sound —
     only provably-unsat items are dropped."""
+    out = None
     if (
         args.tpu_lanes
         and len(items) >= DEVICE_BATCH_THRESHOLD
@@ -99,10 +111,13 @@ def _screen_interval(items: List, get_constraints) -> List:
             )
             out = [it for it, k in zip(items, keep) if k]
             _device_succeeded()
+            STATS["device_screened"] += len(items)
         except Exception as e:
+            # fall THROUGH to the host screen: a flaky device call must
+            # not skip feasibility screening for the wave (sound either
+            # way, but unscreened items pay full solver round trips)
             _device_failed(e)
-            out = items
-    else:
+    if out is None:
         out = []
         for it in items:
             try:
@@ -112,6 +127,8 @@ def _screen_interval(items: List, get_constraints) -> List:
                 pass
             out.append(it)
     dropped = len(items) - len(out)
+    STATS["screened"] += len(items)
+    STATS["pruned"] += dropped
     if dropped:
         log.info("interval pre-filter dropped %d/%d", dropped,
                  len(items))

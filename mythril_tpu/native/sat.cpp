@@ -433,11 +433,33 @@ struct Solver {
   }
 
   // returns: 1 sat, 0 unsat, -1 unknown (budget exhausted)
+  // true iff the trail's propagation closure is complete (only a SAT
+  // exit guarantees it; conflict bails fast-forward qhead past pending
+  // original-clause propagations, so their trails must not be reused)
+  bool trail_clean = true;
+
   int solve(const Lit* assumps, int n_assumps, double timeout_s,
             int64_t conflict_budget) {
     if (!ok) return 0;
-    cancel_until(0);
+    // Assumption-trail reuse: consecutive queries in an incremental
+    // session share long assumption prefixes (path-feasibility storms
+    // differ in a suffix), and each assumption occupies exactly one
+    // decision level — keep the levels whose assumption decisions
+    // match the new prefix instead of re-deciding and re-propagating
+    // the whole prefix closure. Clause additions between queries reset
+    // the trail (add_clause cancels to level 0), so a kept level's
+    // propagation closure is still current.
+    int keep = 0;
+    if (trail_clean) {
+      while (keep < n_assumps && keep < (int)trail_lim.size() &&
+             keep < (int)assumptions.size() &&
+             assumptions[keep] == assumps[keep]) {
+        ++keep;
+      }
+    }
+    cancel_until(keep);
     assumptions.assign(assumps, assumps + n_assumps);
+    trail_clean = false;
     auto t0 = std::chrono::steady_clock::now();
     int64_t confl_limit =
         conflict_budget > 0 ? conflicts + conflict_budget : INT64_MAX;
@@ -511,7 +533,10 @@ struct Solver {
             break;
           }
         }
-        if (next < 0) return 1;  // all assigned: SAT
+        if (next < 0) {
+          trail_clean = true;
+          return 1;  // all assigned: SAT
+        }
         trail_lim.push_back((int)trail.size());
         uncheck_enqueue(mklit(next, saved_phase[next] != T), -1);
       }

@@ -141,6 +141,9 @@ class SymLaneState(NamedTuple):
     pc: jnp.ndarray            # (N,) i32 — byte address
     sp: jnp.ndarray            # (N,) i32
     depth: jnp.ndarray         # (N,) i32 — JUMPI fork depth (host parity)
+    group: jnp.ndarray         # (N,) i32 — seed cohort (same entry
+    #                            template); forks inherit it. Device-side
+    #                            record dedup never merges across groups
     fentry: jnp.ndarray        # (N,) i32 — last function-entry jump dest
     #                            (-1 = none; svm._new_node_state parity)
     last_jump: jnp.ndarray     # (N,) i32 — byte pc of the last executed
@@ -182,17 +185,22 @@ class SymLaneState(NamedTuple):
     dlog_sid: jnp.ndarray      # (N, R, 3) i32
     dlog_val: jnp.ndarray      # (N, R, 3, 8) u32
     dlog_count: jnp.ndarray    # (N,) i32
-    pclog_sid: jnp.ndarray     # (N, P) i32
-    pclog_neg: jnp.ndarray     # (N, P) i32 (1 = negated side)
-    pclog_pc: jnp.ndarray      # (N, P) i32 — byte pc of the JUMPI
-    pclog_step: jnp.ndarray    # (N, P) i32 — global step of the fork
-    pclog_gmin: jnp.ndarray    # (N, P) u32 — gas interval at the JUMPI
-    pclog_gmax: jnp.ndarray    # (N, P) u32   (pre-execution, hook parity)
-    pclog_fentry: jnp.ndarray  # (N, P) i32 — fentry at the JUMPI
-    pclog_count: jnp.ndarray   # (N,) i32
+    # fork table: ONE row per symbolic-JUMPI fork carrying everything
+    # the host drain needs about the fork site (there is no per-lane
+    # path-condition plane: a lane's conditions are reconstructed from
+    # its fork genealogy, and every condition append coincides with a
+    # fork). gmin/gmax are the parent's PRE-execution gas interval at
+    # the JUMPI (hook parity).
     flog_parent: jnp.ndarray   # (F,) i32
     flog_child: jnp.ndarray    # (F,) i32
     flog_step: jnp.ndarray     # (F,) i32
+    flog_pc: jnp.ndarray       # (F,) i32 — byte pc of the JUMPI
+    flog_sid: jnp.ndarray      # (F,) i32 — condition sid (may be
+    #                            provisional until the window-end remap)
+    flog_gmin: jnp.ndarray     # (F,) u32
+    flog_gmax: jnp.ndarray     # (F,) u32
+    flog_fentry: jnp.ndarray   # (F,) i32
+    flog_dest: jnp.ndarray     # (F,) i32 — concrete jump destination
     flog_count: jnp.ndarray    # () i32
     free_slots: jnp.ndarray    # (N,) i32 — stack of free slot indices
     free_count: jnp.ndarray    # () i32
@@ -217,6 +225,7 @@ def _init_sym_lanes_dev(
         pc=z((n,), jnp.int32),
         sp=z((n,), jnp.int32),
         depth=z((n,), jnp.int32),
+        group=z((n,), jnp.int32),
         fentry=jnp.full((n,), -1, jnp.int32),
         last_jump=jnp.full((n,), -1, jnp.int32),
         stack=z((n, stack_depth, bv256.NLIMBS), jnp.uint32),
@@ -253,17 +262,15 @@ def _init_sym_lanes_dev(
         dlog_sid=z((n, dlog_records, 3), jnp.int32),
         dlog_val=z((n, dlog_records, 3, bv256.NLIMBS), jnp.uint32),
         dlog_count=z((n,), jnp.int32),
-        pclog_sid=z((n, pc_records), jnp.int32),
-        pclog_neg=z((n, pc_records), jnp.int32),
-        pclog_pc=z((n, pc_records), jnp.int32),
-        pclog_step=z((n, pc_records), jnp.int32),
-        pclog_gmin=z((n, pc_records), jnp.uint32),
-        pclog_gmax=z((n, pc_records), jnp.uint32),
-        pclog_fentry=z((n, pc_records), jnp.int32),
-        pclog_count=z((n,), jnp.int32),
         flog_parent=z((n,), jnp.int32),
         flog_child=z((n,), jnp.int32),
         flog_step=z((n,), jnp.int32),
+        flog_pc=z((n,), jnp.int32),
+        flog_sid=z((n,), jnp.int32),
+        flog_gmin=z((n,), jnp.uint32),
+        flog_gmax=z((n,), jnp.uint32),
+        flog_fentry=z((n,), jnp.int32),
+        flog_dest=z((n,), jnp.int32),
         flog_count=jnp.zeros((), jnp.int32),
         free_slots=jnp.arange(n - 1, -1, -1, dtype=jnp.int32),
         free_count=jnp.full((), n, jnp.int32),
@@ -372,7 +379,6 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     mem_recs = st.mlog_off.shape[1]
     s_slots = st.skeys.shape[1]
     d_recs = st.dlog_op.shape[1]
-    p_recs = st.pclog_sid.shape[1]
     lanes = jnp.arange(n)
 
     running = st.status == Status.RUNNING
@@ -646,9 +652,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
     # ---- fork request / slot allocation (after park0 so capacity gaps
     # never orphan a fork whose parent already committed to jumping) --------
-    fork_want = running & is_jumpi & sym_b & ~sym_a & dest_ok & ~park0
-    pclog_full_f = fork_want & (st.pclog_count >= p_recs)
-    fork_req = fork_want & ~pclog_full_f
+    fork_req = running & is_jumpi & sym_b & ~sym_a & dest_ok & ~park0
     forder = jnp.cumsum(fork_req.astype(jnp.int32)) - 1
     navail = jnp.minimum(st.free_count, MAX_FORKS_PER_STEP)
     flog_room = st.flog_parent.shape[0] - st.flog_count
@@ -659,7 +663,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # would push whole subtrees back to the host whenever one step
     # wants more than MAX_FORKS_PER_STEP forks
     fork_stall = fork_req & ~fork_can & (forder < st.free_count)
-    fork_nocap = (fork_req & ~fork_can & ~fork_stall) | pclog_full_f
+    fork_nocap = fork_req & ~fork_can & ~fork_stall
 
     park = park0 | fork_nocap
     ok = running & ~park & ~fork_stall
@@ -973,33 +977,6 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         jumped & code.is_func_entry[dest_c2], dest, st.fentry
     )
 
-    # ---- path-condition append (parent side: condition holds) -------------
-    def _pclog_append():
-        pos = jnp.clip(st.pclog_count, 0, p_recs - 1)
-        psid = _scatter_flat(st.pclog_sid, fork_can, pos, sid_b)
-        pneg = _scatter_flat(st.pclog_neg, fork_can, pos, zero_i)
-        # site metadata for drain-time detector adapters: the JUMPI's
-        # byte pc, global step, pre-execution gas interval, and active
-        # function entry (all host pre-hook parity)
-        ppc = _scatter_flat(st.pclog_pc, fork_can, pos, st.pc)
-        pstep = _scatter_flat(
-            st.pclog_step, fork_can, pos,
-            jnp.full((n,), st.step_no, jnp.int32))
-        pgmin = _scatter_flat(st.pclog_gmin, fork_can, pos, st.min_gas)
-        pgmax = _scatter_flat(st.pclog_gmax, fork_can, pos, st.max_gas)
-        pfen = _scatter_flat(st.pclog_fentry, fork_can, pos, st.fentry)
-        pcount = jnp.where(fork_can, st.pclog_count + 1, st.pclog_count)
-        return psid, pneg, ppc, pstep, pgmin, pgmax, pfen, pcount
-
-    (pclog_sid2, pclog_neg2, pclog_pc2, pclog_step2, pclog_gmin2,
-     pclog_gmax2, pclog_fentry2, pclog_count2) = lax.cond(
-        jnp.any(fork_can),
-        _pclog_append,
-        lambda: (st.pclog_sid, st.pclog_neg, st.pclog_pc, st.pclog_step,
-                 st.pclog_gmin, st.pclog_gmax, st.pclog_fentry,
-                 st.pclog_count),
-    )
-
     # ---- gas / status / bookkeeping ---------------------------------------
     min_gas = jnp.where(ok, st.min_gas + gmin, st.min_gas)
     max_gas = jnp.where(ok, st.max_gas + gmax, st.max_gas)
@@ -1038,14 +1015,6 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         dlog_sid=dlog_sid2,
         dlog_val=dlog_val2,
         dlog_count=dlog_count2,
-        pclog_sid=pclog_sid2,
-        pclog_neg=pclog_neg2,
-        pclog_pc=pclog_pc2,
-        pclog_step=pclog_step2,
-        pclog_gmin=pclog_gmin2,
-        pclog_gmax=pclog_gmax2,
-        pclog_fentry=pclog_fentry2,
-        pclog_count=pclog_count2,
         step_no=st.step_no + 1,
     )
 
@@ -1068,8 +1037,10 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
         # fields whose leading axis is NOT the lane axis (fork/free-slot
         # bookkeeping) must not be row-copied
-        no_copy = {"flog_parent", "flog_child", "flog_step",
-                   "flog_count", "free_slots", "free_count", "step_no"}
+        no_copy = {"flog_parent", "flog_child", "flog_step", "flog_pc",
+                   "flog_sid", "flog_gmin", "flog_gmax", "flog_fentry",
+                   "flog_dest", "flog_count", "free_slots",
+                   "free_count", "step_no"}
 
         def copy_rows(name, x):
             if name in no_copy or x.ndim == 0 or x.shape[0] != n:
@@ -1079,29 +1050,34 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         s2 = SymLaneState(
             **{f: copy_rows(f, getattr(s, f)) for f in s._fields}
         )
-        # child diverges: fall-through pc, negated path condition; it
+        # child diverges: fall-through pc (negated condition side); it
         # did not take the jump, so it keeps the pre-step function entry
         fall_pc = next_pc[parent_c]
+        frow = jnp.where(valid, s.flog_count + fslot, n)
         s2 = s2._replace(
             pc=s2.pc.at[child_rows].set(fall_pc, mode="drop"),
             fentry=s2.fentry.at[child_rows].set(
                 st.fentry[parent_c], mode="drop"),
-            pclog_neg=s2.pclog_neg.at[
-                child_rows,
-                jnp.clip(s2.pclog_count[parent_c] - 1, 0, p_recs - 1),
-            ].set(1, mode="drop"),
             # the child minted no deferred records of its own
             dlog_count=s2.dlog_count.at[child_rows].set(0, mode="drop"),
-            flog_parent=s2.flog_parent.at[
-                jnp.where(valid, s.flog_count + fslot, n)
-            ].set(parent_rows, mode="drop"),
-            flog_child=s2.flog_child.at[
-                jnp.where(valid, s.flog_count + fslot, n)
-            ].set(child_rows, mode="drop"),
-            flog_step=s2.flog_step.at[
-                jnp.where(valid, s.flog_count + fslot, n)
-            ].set(jnp.full((maxf,), st.step_no, jnp.int32),
-                  mode="drop"),
+            flog_parent=s2.flog_parent.at[frow].set(
+                parent_rows, mode="drop"),
+            flog_child=s2.flog_child.at[frow].set(
+                child_rows, mode="drop"),
+            flog_step=s2.flog_step.at[frow].set(
+                jnp.full((maxf,), st.step_no, jnp.int32), mode="drop"),
+            flog_pc=s2.flog_pc.at[frow].set(
+                st.pc[parent_c], mode="drop"),
+            flog_sid=s2.flog_sid.at[frow].set(
+                sid_b[parent_c], mode="drop"),
+            flog_gmin=s2.flog_gmin.at[frow].set(
+                st.min_gas[parent_c], mode="drop"),
+            flog_gmax=s2.flog_gmax.at[frow].set(
+                st.max_gas[parent_c], mode="drop"),
+            flog_fentry=s2.flog_fentry.at[frow].set(
+                st.fentry[parent_c], mode="drop"),
+            flog_dest=s2.flog_dest.at[frow].set(
+                dest[parent_c], mode="drop"),
             flog_count=s.flog_count + nf,
             free_count=s.free_count - nf,
         )

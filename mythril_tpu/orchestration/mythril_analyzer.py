@@ -75,8 +75,17 @@ class MythrilAnalyzer:
         args.tpu_lanes = getattr(cmd_args, "tpu_lanes", args.tpu_lanes)
         if args.pruning_factor is None:
             args.pruning_factor = 1 if self.execution_timeout > 600 else 0
+        # per-run context (SURVEY §5): this analyzer's keccak axioms,
+        # model caches, solver session, detector issue lists, and Args
+        # values live in its own context — two analyzers in one process
+        # stay independent with no manual cache clearing
+        from ..support.run_context import RunContext
+
+        self._run_context = RunContext()
+        self._run_context.snapshot_args()
 
     def _sym_exec(self, contract, modules, transaction_count):
+        self._run_context.activate()
         return SymExecWrapper(
             contract,
             self.address,
@@ -98,6 +107,7 @@ class MythrilAnalyzer:
         return get_serializable_statespace(sym)
 
     def _sym_exec_statespace(self, contract):
+        self._run_context.activate()
         return SymExecWrapper(
             contract,
             self.address,
@@ -120,6 +130,7 @@ class MythrilAnalyzer:
                     transaction_count: int = 2) -> Report:
         """Analyze every loaded contract; issues and per-contract crashes
         both land in the report."""
+        self._run_context.activate()
         all_issues: List[Issue] = []
         exceptions = []
         execution_info = None
@@ -136,6 +147,10 @@ class MythrilAnalyzer:
                 sym = self._sym_exec(contract, modules, transaction_count)
                 issues = fire_lasers(sym, modules)
                 execution_info = sym.execution_info
+                for issue in issues:
+                    # source-map against the contract that produced the
+                    # issue (reference mythril_analyzer.py:168)
+                    issue.add_code_info(contract)
                 all_issues += issues
             except KeyboardInterrupt:
                 log.critical("keyboard interrupt: flushing partial results")
@@ -157,6 +172,5 @@ class MythrilAnalyzer:
             execution_info=execution_info,
         )
         for issue in all_issues:
-            issue.add_code_info(self.contracts)
             report.append_issue(issue)
         return report

@@ -73,8 +73,18 @@ def _barrier(name: str) -> None:
 
 def default_analyze(path: str, timeout: int = 60,
                     tpu_lanes: int = 0) -> dict:
-    """One contract end to end with the full default detector set."""
+    """One contract end to end with the full default detector set.
+
+    MTPU_ANALYZE_DELAY (seconds, test support): extra sleep per
+    contract, simulating per-host wall latency (solver waits, device
+    round trips) on test boxes where every rank shares one CPU —
+    scheduling properties like work-stealing makespan are only
+    observable when work is not purely CPU-bound."""
     from types import SimpleNamespace
+
+    delay = float(os.environ.get("MTPU_ANALYZE_DELAY", "0") or 0)
+    if delay:
+        time.sleep(delay)
 
     from ..orchestration.mythril_analyzer import MythrilAnalyzer
     from ..orchestration.mythril_disassembler import MythrilDisassembler
@@ -104,29 +114,89 @@ def default_analyze(path: str, timeout: int = 60,
     }
 
 
+def _kv_client():
+    """The coordinator's key-value store (None when standalone) — the
+    DCN-side channel the work-stealing claims ride."""
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is not None and hasattr(client, "key_value_increment"):
+            return client
+    except Exception:
+        pass
+    return None
+
+
+def _claim(client, item: str, owner: bool) -> bool:
+    """Atomically claim a work item group-wide: the coordinator's
+    key_value_increment is an atomic fetch-add, so exactly one rank
+    sees 1. On a degraded coordinator the OWNER keeps its shard (work
+    must never be dropped; the worst case is duplicate analysis, which
+    the merge dedups) while thieves claim nothing."""
+    try:
+        return client.key_value_increment(f"mtpu_claim:{item}", 1) == 1
+    except Exception as e:  # pragma: no cover - degraded coordinator
+        log.warning("work-claim failed (%s); %s", e,
+                    "owner keeps the item" if owner
+                    else "not stealing")
+        return owner
+
+
 def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
                num_processes: int,
-               analyze: Callable[[str], dict] = default_analyze) -> dict:
-    """Analyze this rank's shard, write shard_<rank>.json, barrier, and
-    (rank 0) merge every shard into corpus_report.json."""
+               analyze: Callable[[str], dict] = default_analyze,
+               steal: bool = True) -> dict:
+    """Analyze this rank's shard — then STEAL unstarted contracts from
+    other ranks' shards (SURVEY §2.10 distributed-backend row: work
+    moves between hosts over DCN when a shard drains early). Each item
+    is started under an atomic coordinator-side claim, so a stolen item
+    never runs twice; thieves walk victim shards tail-first while
+    owners work head-first, keeping contention at the boundary. Then
+    write shard_<rank>.json, barrier, and (rank 0) merge every shard
+    into corpus_report.json."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     shard = shard_corpus(paths, process_id, num_processes)
+    client = _kv_client() if num_processes > 1 else None
     results = []
     t0 = time.perf_counter()
-    for path in shard:
+
+    def _run_one(path, stolen_from=None):
         try:
-            results.append(analyze(path))
+            r = analyze(path)
         except Exception as e:  # keep sweeping — reference parity with
             # the analyzer's per-contract exception capture
             log.warning("analysis of %s failed: %s", path, e)
-            results.append(
-                {"contract": Path(path).name, "error": type(e).__name__}
-            )
+            r = {"contract": Path(path).name, "error": type(e).__name__}
+        r["path"] = str(path)  # merge dedups on the full path
+        if stolen_from is not None:
+            r["stolen_from"] = stolen_from
+        results.append(r)
+
+    for path in shard:
+        if client is not None and steal and not _claim(client, path,
+                                                       owner=True):
+            log.info("rank %d: %s already claimed by a thief",
+                     process_id, path)
+            continue
+        _run_one(path)
+    if client is not None and steal:
+        # drained: steal the tail of the busiest-looking victims
+        for victim in range(num_processes):
+            if victim == process_id:
+                continue
+            for path in reversed(shard_corpus(paths, victim,
+                                              num_processes)):
+                if _claim(client, path, owner=False):
+                    log.info("rank %d: stole %s from rank %d",
+                             process_id, path, victim)
+                    _run_one(path, stolen_from=victim)
     shard_report = {
         "process_id": process_id,
         "num_processes": num_processes,
         "wall_s": round(time.perf_counter() - t0, 2),
+        "stolen": sum(1 for r in results if "stolen_from" in r),
         "results": results,
     }
     (out / f"shard_{process_id}.json").write_text(
@@ -135,7 +205,9 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     if process_id != 0:
         return shard_report
     merged = {"num_processes": num_processes, "contracts": [],
-              "total_issues": 0, "errors": 0, "shards": []}
+              "total_issues": 0, "errors": 0, "stolen": 0,
+              "shards": []}
+    seen = set()
     for rank in range(num_processes):
         shard_file = out / f"shard_{rank}.json"
         if not shard_file.exists():
@@ -148,8 +220,14 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
         data = json.loads(shard_file.read_text())
         merged["shards"].append(
             {"process_id": rank, "wall_s": data["wall_s"],
-             "n": len(data["results"])})
+             "n": len(data["results"]),
+             "stolen": data.get("stolen", 0)})
+        merged["stolen"] += data.get("stolen", 0)
         for r in data["results"]:
+            key = r.get("path", r["contract"])
+            if key in seen:  # duplicate = degraded-coordinator rerun
+                continue
+            seen.add(key)
             merged["contracts"].append(r)
             merged["total_issues"] += r.get("issues", 0)
             merged["errors"] += 1 if "error" in r else 0
@@ -169,6 +247,9 @@ def main(argv=None) -> int:
     parser.add_argument("--out-dir", required=True)
     parser.add_argument("--timeout", type=int, default=60)
     parser.add_argument("--tpu-lanes", type=int, default=0)
+    parser.add_argument("--no-steal", action="store_true",
+                        help="static shards only (no cross-host "
+                        "work-stealing when a shard drains early)")
     parser.add_argument("files", nargs="+")
     args = parser.parse_args(argv)
 
@@ -180,6 +261,7 @@ def main(argv=None) -> int:
         args.files, args.out_dir, rank, num_processes,
         analyze=lambda p: default_analyze(
             p, timeout=args.timeout, tpu_lanes=args.tpu_lanes),
+        steal=not args.no_steal,
     )
     print(json.dumps(report))
     return 0

@@ -77,6 +77,13 @@ def force_virtual_cpu(n_devices: int) -> None:
         jax.config.update("jax_num_cpu_devices", n_devices)
     except Exception:
         pass  # older jax: XLA_FLAGS alone covers it
+    # the teardown above reaches into jax private internals — if a jax
+    # upgrade renames them, the silent skip would leave the real-chip
+    # backend active; verify the platform actually switched
+    assert jax.devices()[0].platform == "cpu", (
+        "virtual-CPU reconfig failed: backend still "
+        f"{jax.devices()[0].platform} (jax internals changed?)"
+    )
 
 
 def ensure_devices(n_devices: int) -> None:

@@ -47,7 +47,8 @@ def test_two_process_corpus(tmp_path):
             [sys.executable, "-m", "mythril_tpu.parallel.corpus",
              "--coordinator", coordinator,
              "--num-processes", "2", "--process-id", str(rank),
-             "--out-dir", str(tmp_path), "--timeout", "60"] + files,
+             "--out-dir", str(tmp_path), "--timeout", "60",
+             "--no-steal"] + files,
             cwd="/root/repo", env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))

@@ -1,0 +1,91 @@
+"""Cross-host work-stealing (SURVEY.md §2.10 distributed-backend row):
+when a rank's corpus shard drains early, it claims unstarted contracts
+from other ranks' shards through the coordinator's atomic key-value
+store — the imbalanced corpus finishes faster with stealing on, with
+identical merged reports (reference analog: 30 statically-assigned CLI
+processes, /root/reference/tests/integration_tests/parallel_test.py)."""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+
+# shards are round-robin over SORTED names: heavy copies (metacoin,
+# ~1.3 s each) at even sort positions all land on rank 0, featherweight
+# copies (nonascii, ~0.1 s) at odd positions on rank 1 — a deliberately
+# imbalanced corpus
+HEAVY, LIGHT = "metacoin.sol.o", "nonascii.sol.o"
+
+
+def _rigged_corpus(tmp_path):
+    files = []
+    for i in range(4):
+        dst = tmp_path / f"f{2 * i}_{HEAVY}"
+        shutil.copy(INPUTS / HEAVY, dst)
+        files.append(str(dst))
+        dst = tmp_path / f"f{2 * i + 1}_{LIGHT}"
+        shutil.copy(INPUTS / LIGHT, dst)
+        files.append(str(dst))
+    return files
+
+
+def _run(tmp_path, files, out_name, steal):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    out_dir = tmp_path / out_name
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        # the test box shares ONE cpu between both ranks, so pure
+        # cpu-bound work cannot be sped up by redistribution; the
+        # per-contract delay models the per-host latency (solver
+        # waits, device round trips) real deployments have
+        env["MTPU_ANALYZE_DELAY"] = "1.5"
+        cmd = [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+               "--coordinator", coordinator,
+               "--num-processes", "2", "--process-id", str(rank),
+               "--out-dir", str(out_dir), "--timeout", "60"]
+        if not steal:
+            cmd.append("--no-steal")
+        procs.append(subprocess.Popen(
+            cmd + files, cwd="/root/repo", env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=900) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+    return json.loads((out_dir / "corpus_report.json").read_text())
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+def test_stealing_balances_imbalanced_corpus(tmp_path):
+    files = _rigged_corpus(tmp_path)
+
+    static = _run(tmp_path, files, "static", steal=False)
+    stolen = _run(tmp_path, files, "steal", steal=True)
+
+    # identical merged reports (modulo the stolen_from provenance)
+    def canon(m):
+        return [(c["contract"], c.get("issues"), c.get("swc"))
+                for c in m["contracts"]]
+
+    assert canon(static) == canon(stolen)
+    assert static["errors"] == 0 and stolen["errors"] == 0
+
+    # the light rank actually stole from the heavy rank
+    assert stolen["stolen"] >= 1
+    # makespan = max shard wall; stealing must beat the static split
+    static_makespan = max(s["wall_s"] for s in static["shards"])
+    steal_makespan = max(s["wall_s"] for s in stolen["shards"])
+    assert steal_makespan < static_makespan

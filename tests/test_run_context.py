@@ -1,0 +1,74 @@
+"""Per-run context isolation (SURVEY §5 parallel-safe contexts):
+two MythrilAnalyzer instances in ONE process — even alternating — must
+produce independent, correct results with no manual cache clearing
+(the reference's process singletons assume one contract per process;
+reference mythril/support/support_args.py:5-43)."""
+
+from pathlib import Path
+from types import SimpleNamespace
+
+INPUTS = Path("/root/reference/tests/testdata/inputs")
+
+
+def _make_analyzer(fixture: str, timeout: int = 60):
+    from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+
+    disassembler = MythrilDisassembler(eth=None)
+    address, _ = disassembler.load_from_bytecode(
+        (INPUTS / fixture).read_text().strip(), bin_runtime=True
+    )
+    cmd_args = SimpleNamespace(
+        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=None, unconstrained_storage=False,
+        parallel_solving=False, call_depth_limit=3,
+        disable_dependency_pruning=False, custom_modules_directory="",
+        solver_log=None, transaction_sequences=None, tpu_lanes=0,
+    )
+    return MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+
+
+def _canon(report):
+    return sorted(
+        (i["swc-id"], i["address"], i["title"])
+        for i in report.sorted_issues()
+    )
+
+
+def test_alternating_analyzers_are_independent():
+    a1 = _make_analyzer("suicide.sol.o")
+    b = _make_analyzer("origin.sol.o")
+
+    first = _canon(a1.fire_lasers(modules=None, transaction_count=2))
+    b_report = _canon(b.fire_lasers(modules=None, transaction_count=2))
+    # a SECOND analyzer over the same fixture, after b ran in between:
+    # same report, no manual cache clearing
+    a2 = _make_analyzer("suicide.sol.o")
+    second = _canon(a2.fire_lasers(modules=None, transaction_count=2))
+
+    assert first, "suicide fixture must report an issue"
+    assert b_report, "origin fixture must report an issue"
+    assert first == second
+    swcs_a = {i[0] for i in first}
+    swcs_b = {i[0] for i in b_report}
+    assert "106" in swcs_a and "106" not in swcs_b
+    assert "115" in swcs_b and "115" not in swcs_a
+
+
+def test_context_isolates_args():
+    from mythril_tpu.support.support_args import args
+
+    a = _make_analyzer("suicide.sol.o", timeout=60)
+    args_snapshot_a = dict(vars(args))
+    b = _make_analyzer("origin.sol.o", timeout=60)
+    b.cmd_args_solver = args.solver_timeout
+    # activating a's context restores a's flag values
+    a._run_context.activate()
+    for key, val in args_snapshot_a.items():
+        assert getattr(args, key) == val, key

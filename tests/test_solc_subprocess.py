@@ -1,0 +1,112 @@
+"""Live solc SUBPROCESS path: the front-end invoking an actual solc
+binary end to end — binary lookup, --standard-json + --allow-paths
+argv, the stdin/stdout JSON protocol, error surfacing, and a
+source-mapped issue from a .sol input through the full analyzer
+(reference mythril/ethereum/util.py:41-108,
+mythril/solidity/soliditycontract.py:168-234).
+
+No solc exists in this image and there is no egress to fetch one, so
+the binary under test is tools/fake_solc.py — a real subprocess
+speaking the solc CLI protocol that replays a recorded deterministic
+compilation of the reference's suicide.sol (PARITY.md documents the
+substitution). Everything on OUR side of the process boundary is the
+production code path.
+"""
+
+import json
+import os
+import shutil
+import stat
+import sys
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.solidity.soliditycontract import SolidityContract
+from mythril_tpu.solidity.util import SolcError, get_solc_json
+
+REF = Path("/root/reference/tests/testdata")
+SOURCE_FILE = REF / "input_contracts" / "suicide.sol"
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def solc_bin(tmp_path):
+    """An executable `solc` on disk (wrapper around the transcript
+    binary, so the front-end runs a genuine subprocess)."""
+    path = tmp_path / "solc"
+    path.write_text(
+        "#!/bin/sh\n"
+        f'exec "{sys.executable}" "{REPO / "tools" / "fake_solc.py"}" '
+        '"$@"\n'
+    )
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return str(path)
+
+
+@pytest.fixture
+def source(tmp_path):
+    dst = tmp_path / "suicide.sol"
+    shutil.copy(SOURCE_FILE, dst)
+    return str(dst)
+
+
+@pytest.mark.skipif(not SOURCE_FILE.exists(), reason="no fixtures")
+def test_get_solc_json_subprocess_protocol(solc_bin, source, tmp_path,
+                                           monkeypatch):
+    log = tmp_path / "argv.json"
+    monkeypatch.setenv("FAKE_SOLC_LOG", str(log))
+    out = get_solc_json(source, solc_binary=solc_bin)
+    argv = json.loads(log.read_text())
+    assert "--standard-json" in argv
+    ap = argv[argv.index("--allow-paths") + 1]
+    assert os.path.dirname(source) == ap
+    assert source in out["contracts"]
+    evm = out["contracts"][source]["Suicide"]["evm"]
+    assert evm["deployedBytecode"]["object"]
+    assert ";" in evm["deployedBytecode"]["sourceMap"]
+
+
+@pytest.mark.skipif(not SOURCE_FILE.exists(), reason="no fixtures")
+def test_missing_binary_raises_solc_error(source):
+    with pytest.raises(SolcError):
+        get_solc_json(source, solc_binary="/nonexistent/solc")
+
+
+@pytest.mark.skipif(not SOURCE_FILE.exists(), reason="no fixtures")
+def test_unknown_source_surfaces_compiler_error(solc_bin, tmp_path):
+    bad = tmp_path / "other.sol"
+    bad.write_text("contract C { function f() public {} }")
+    with pytest.raises(SolcError):
+        get_solc_json(str(bad), solc_binary=solc_bin)
+
+
+@pytest.mark.skipif(not SOURCE_FILE.exists(), reason="no fixtures")
+def test_sol_to_source_mapped_issue_via_subprocess(solc_bin, source):
+    """.sol input -> subprocess solc -> SolidityContract -> analyzer ->
+    SWC-106 with the selfdestruct source line attached."""
+    from types import SimpleNamespace
+
+    from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+
+    contract = SolidityContract(source, solc_binary=solc_bin)
+    disassembler = SimpleNamespace(
+        eth=None, contracts=[contract], enable_online_lookup=False)
+    cmd_args = SimpleNamespace(
+        execution_timeout=60, max_depth=128, solver_timeout=10000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=None, unconstrained_storage=False,
+        parallel_solving=False, call_depth_limit=3,
+        disable_dependency_pruning=False, custom_modules_directory="",
+        solver_log=None, transaction_sequences=None, tpu_lanes=0,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address="0x" + "0" * 40)
+    report = analyzer.fire_lasers(
+        modules=["AccidentallyKillable"], transaction_count=1)
+    issues = report.sorted_issues()
+    assert any(i["swc-id"] == "106" for i in issues)
+    sd = next(i for i in issues if i["swc-id"] == "106")
+    assert "selfdestruct" in (sd.get("code") or "")
+    assert sd.get("lineno")
