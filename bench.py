@@ -209,10 +209,12 @@ def bench_symbolic(n_lanes=4096, trials=None):
         lane_engine.warm_variant(n_lanes, len(code), {}, 48, 8192,
                                  seed_bucket=bucket, block=True)
     host_walls, lane_walls = [], []
-    lane_engine.RUN_STATS_TOTAL = {}
     for _ in range(trials):
         host_s, host_paths = _explore(code, 0)
         host_walls.append(host_s)
+        # per-run stats: reset per trial so the reported detail is ONE
+        # run's forks/steps/windows, not a sum over trials
+        lane_engine.RUN_STATS_TOTAL = {}
         lane_s, lane_paths = _explore(code, n_lanes)
         lane_walls.append(lane_s)
         assert lane_paths == host_paths, (lane_paths, host_paths)
@@ -240,9 +242,8 @@ def _analyze_fixture(path, timeout, tx_count, tpu_lanes):
     the config-2/3 measurement core (BASELINE.md table; the .sol
     sources named there need solc, absent in this image, so the
     nearest precompiled testdata fixtures stand in)."""
-    from types import SimpleNamespace
-
     from mythril_tpu.models import pruner
+    from mythril_tpu.support.analysis_args import make_cmd_args
     from mythril_tpu.support.model import SCREEN_STATS
     from mythril_tpu.orchestration.mythril_analyzer import (
         MythrilAnalyzer, reset_analysis_state,
@@ -261,14 +262,9 @@ def _analyze_fixture(path, timeout, tx_count, tpu_lanes):
     disassembler = MythrilDisassembler(eth=None)
     address, _ = disassembler.load_from_bytecode(
         path.read_text().strip(), bin_runtime=True)
-    cmd_args = SimpleNamespace(
-        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
-        no_onchain_data=True, loop_bound=3, create_timeout=10,
+    cmd_args = make_cmd_args(
+        execution_timeout=timeout, tpu_lanes=tpu_lanes,
         pruning_factor=1.0 if tpu_lanes else None,
-        unconstrained_storage=False, parallel_solving=False,
-        call_depth_limit=3, disable_dependency_pruning=False,
-        custom_modules_directory="", solver_log=None,
-        transaction_sequences=None, tpu_lanes=tpu_lanes,
     )
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",

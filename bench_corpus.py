@@ -11,7 +11,6 @@ import json
 import sys
 import time
 from pathlib import Path
-from types import SimpleNamespace
 
 INPUTS = Path("/root/reference/tests/testdata/inputs")
 
@@ -33,21 +32,15 @@ def analyze_one(path: Path, timeout: int, tpu_lanes: int = 0):
     from mythril_tpu.orchestration.mythril_disassembler import (
         MythrilDisassembler,
     )
+    from mythril_tpu.support.analysis_args import make_cmd_args
 
     disassembler = MythrilDisassembler(eth=None)
     code = path.read_text().strip()
     address, _ = disassembler.load_from_bytecode(
         code, bin_runtime=path.name not in CREATION_FIXTURES
     )
-    cmd_args = SimpleNamespace(
-        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
-        no_onchain_data=True, loop_bound=3, create_timeout=10,
-        pruning_factor=None, unconstrained_storage=False,
-        parallel_solving=False, call_depth_limit=3,
-        disable_dependency_pruning=False, custom_modules_directory="",
-        solver_log=None, transaction_sequences=None,
-        tpu_lanes=tpu_lanes,
-    )
+    cmd_args = make_cmd_args(execution_timeout=timeout,
+                             tpu_lanes=tpu_lanes)
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address,

@@ -220,8 +220,13 @@ class CompiledCode(NamedTuple):
 # padded code-tensor sizes: every distinct tensor length is a separate
 # XLA compilation of the (large) stepper kernels, so contracts share a
 # handful of padded shapes instead (tail is STOP-filled and unreachable
-# past `size`, which is a traced scalar)
-_CODE_BUCKETS = (256, 1024, 4096, 16384, 65536)
+# past `size`, which is a traced scalar). The floor is one generous
+# bucket: code planes live on device (the per-step cost of a bigger
+# table is a wider gather, not a transfer), while every extra bucket
+# costs a ~25 s stepper compile that contends with the host
+# interpreter on small machines — measured, three buckets across a
+# corpus cost more wall than all the padding ever could.
+_CODE_BUCKETS = (4096, 16384, 65536)
 
 
 def _code_bucket(length: int) -> int:

@@ -80,27 +80,19 @@ def default_analyze(path: str, timeout: int = 60,
     round trips) on test boxes where every rank shares one CPU —
     scheduling properties like work-stealing makespan are only
     observable when work is not purely CPU-bound."""
-    from types import SimpleNamespace
-
     delay = float(os.environ.get("MTPU_ANALYZE_DELAY", "0") or 0)
     if delay:
         time.sleep(delay)
 
     from ..orchestration.mythril_analyzer import MythrilAnalyzer
     from ..orchestration.mythril_disassembler import MythrilDisassembler
+    from ..support.analysis_args import make_cmd_args
 
     disassembler = MythrilDisassembler(eth=None)
     code = Path(path).read_text().strip()
     address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
-    cmd_args = SimpleNamespace(
-        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
-        no_onchain_data=True, loop_bound=3, create_timeout=10,
-        pruning_factor=None, unconstrained_storage=False,
-        parallel_solving=False, call_depth_limit=3,
-        disable_dependency_pruning=False, custom_modules_directory="",
-        solver_log=None, transaction_sequences=None,
-        tpu_lanes=tpu_lanes,
-    )
+    cmd_args = make_cmd_args(execution_timeout=timeout,
+                             tpu_lanes=tpu_lanes)
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address,

@@ -79,11 +79,14 @@ def force_virtual_cpu(n_devices: int) -> None:
         pass  # older jax: XLA_FLAGS alone covers it
     # the teardown above reaches into jax private internals — if a jax
     # upgrade renames them, the silent skip would leave the real-chip
-    # backend active; verify the platform actually switched
-    assert jax.devices()[0].platform == "cpu", (
-        "virtual-CPU reconfig failed: backend still "
-        f"{jax.devices()[0].platform} (jax internals changed?)"
-    )
+    # backend active; verify the platform actually switched (explicit
+    # raise, not assert: the guard must survive python -O)
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        raise RuntimeError(
+            "virtual-CPU reconfig failed: backend still "
+            f"{platform} (jax internals changed?)"
+        )
 
 
 def ensure_devices(n_devices: int) -> None:

@@ -5,7 +5,6 @@ produce independent, correct results with no manual cache clearing
 reference mythril/support/support_args.py:5-43)."""
 
 from pathlib import Path
-from types import SimpleNamespace
 
 INPUTS = Path("/root/reference/tests/testdata/inputs")
 
@@ -15,19 +14,13 @@ def _make_analyzer(fixture: str, timeout: int = 60):
     from mythril_tpu.orchestration.mythril_disassembler import (
         MythrilDisassembler,
     )
+    from mythril_tpu.support.analysis_args import make_cmd_args
 
     disassembler = MythrilDisassembler(eth=None)
     address, _ = disassembler.load_from_bytecode(
         (INPUTS / fixture).read_text().strip(), bin_runtime=True
     )
-    cmd_args = SimpleNamespace(
-        execution_timeout=timeout, max_depth=128, solver_timeout=10000,
-        no_onchain_data=True, loop_bound=3, create_timeout=10,
-        pruning_factor=None, unconstrained_storage=False,
-        parallel_solving=False, call_depth_limit=3,
-        disable_dependency_pruning=False, custom_modules_directory="",
-        solver_log=None, transaction_sequences=None, tpu_lanes=0,
-    )
+    cmd_args = make_cmd_args(execution_timeout=timeout)
     return MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address,

@@ -88,18 +88,12 @@ def test_sol_to_source_mapped_issue_via_subprocess(solc_bin, source):
     from types import SimpleNamespace
 
     from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+    from mythril_tpu.support.analysis_args import make_cmd_args
 
     contract = SolidityContract(source, solc_binary=solc_bin)
     disassembler = SimpleNamespace(
         eth=None, contracts=[contract], enable_online_lookup=False)
-    cmd_args = SimpleNamespace(
-        execution_timeout=60, max_depth=128, solver_timeout=10000,
-        no_onchain_data=True, loop_bound=3, create_timeout=10,
-        pruning_factor=None, unconstrained_storage=False,
-        parallel_solving=False, call_depth_limit=3,
-        disable_dependency_pruning=False, custom_modules_directory="",
-        solver_log=None, transaction_sequences=None, tpu_lanes=0,
-    )
+    cmd_args = make_cmd_args()
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address="0x" + "0" * 40)
