@@ -66,9 +66,12 @@ class ModelCache:
     MIN_SCAN = 4
 
     def __init__(self):
+        from ..smt.repair import REPAIR_MODELS
+
         self.model_cache = LRUCache(size=100)
         self._scan = self.MAX_SCAN
         self._misses = 0
+        self._repair_tries = REPAIR_MODELS
 
     def check_quick_sat(self, constraint_term) -> object:
         scanned = 0
@@ -86,10 +89,37 @@ class ModelCache:
                 self._misses = 0
                 self._scan = min(self._scan * 2, self.MAX_SCAN)
                 return model
+        # scan miss: attempt a path-guided repair of the most recent
+        # models — fork storms (every leaf a distinct path condition)
+        # are exactly the workload where the plain scan always misses
+        # but a sibling's model is a few flipped branch bits away. The
+        # attempt budget rides the same miss backoff as the scan width:
+        # on workloads where repair never lands it decays to one donor.
+        from ..smt.repair import REPAIR_MODELS, try_repair
+
+        tried = 0
+        for model in reversed(self.model_cache.lru_cache.keys()):
+            if tried >= self._repair_tries:
+                break
+            tried += 1
+            try:
+                fixed = try_repair(constraint_term, model)
+            except Exception:
+                break  # repair is an optimization, never an error path
+            if fixed is not None:
+                # a repair hit must NOT re-grow the scan width: in a
+                # fork storm the plain scan never hits (every query is
+                # a distinct path condition) and re-pegging _scan to
+                # MAX would re-introduce the 100-model re-evaluation
+                # cost per query that the backoff exists to cut
+                self.model_cache.put(fixed, 1)
+                self._repair_tries = REPAIR_MODELS
+                return fixed
         self._misses += 1
         if self._misses >= 8:
             self._misses = 0
             self._scan = max(self._scan // 2, self.MIN_SCAN)
+            self._repair_tries = max(self._repair_tries // 2, 1)
         return None
 
     def put(self, model, weight) -> None:

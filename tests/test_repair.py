@@ -1,0 +1,133 @@
+"""Path-guided model repair (smt/repair.py): quick-sat for sibling
+path conditions without a CDCL round trip."""
+
+import pytest
+
+from mythril_tpu.smt import repair
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.smt.model import Model
+from mythril_tpu.smt.solver.core import ModelData
+from mythril_tpu.support.model import get_model
+from mythril_tpu.support.support_utils import ModelCache
+from mythril_tpu.smt import And
+
+
+def _model(bv=None, arrays=None):
+    md = ModelData()
+    md.bv = dict(bv or {})
+    md.arrays = dict(arrays or {})
+    return Model([md])
+
+
+def _bv(name):
+    return symbol_factory.BitVecSym(name, 256)
+
+
+def _c(v):
+    return symbol_factory.BitVecVal(v, 256)
+
+
+def test_repairs_flipped_bit_literal():
+    x = _bv("x")
+    donor = _model({"x": 0})
+    fixed = repair.try_repair(((x & 1) == 1).raw, donor)
+    assert fixed is not None
+    assert fixed.raw[0].bv["x"] & 1 == 1
+
+
+def test_repair_preserves_untouched_bits():
+    x = _bv("x")
+    donor = _model({"x": 0xF0})
+    fixed = repair.try_repair(
+        And((x & 1) == 1, (x & 0xF0) == 0xF0).raw, donor
+    )
+    assert fixed is not None
+    assert fixed.raw[0].bv["x"] == 0xF1
+
+
+def test_conflicting_requirements_abort():
+    x = _bv("x")
+    donor = _model({"x": 0})
+    term = And((x & 1) == 1, (x & 1) == 0).raw
+    assert repair.try_repair(term, donor) is None
+
+
+def test_verification_rejects_bad_guess():
+    # the forcer can satisfy the first conjunct, but the arithmetic
+    # conjunct is opaque to it and false under the patch -> reject
+    x = _bv("x")
+    donor = _model({"x": 0})
+    term = And((x & 1) == 1, x * x == _c(0)).raw
+    assert repair.try_repair(term, donor) is None
+
+
+def test_ite_guard_uses_donor_arm():
+    # ite(size > 3, data, 0) == 5 with the guard already true under the
+    # donor: only the data cell is forced, size stays put
+    from mythril_tpu.smt import terms as T
+
+    size = _bv("size")
+    data = _bv("data")
+    guarded = T.mk_ite(
+        T.mk_slt(_c(3).raw, size.raw), data.raw, _c(0).raw
+    )
+    donor = _model({"size": 32, "data": 0})
+    term = T.mk_eq(guarded, _c(5).raw)
+    fixed = repair.try_repair(term, donor)
+    assert fixed is not None
+    assert fixed.raw[0].bv["data"] == 5
+    assert fixed.raw[0].bv["size"] == 32
+
+
+def test_disequality_and_bounds():
+    x = _bv("x")
+    donor = _model({"x": 7})
+    from mythril_tpu.smt import Not
+
+    fixed = repair.try_repair(Not(x == _c(7)).raw, donor)
+    assert fixed is not None
+    assert fixed.raw[0].bv["x"] != 7
+
+    from mythril_tpu.smt import ULT
+
+    fixed = repair.try_repair(ULT(x, _c(4)).raw, donor)
+    assert fixed is not None
+    assert fixed.raw[0].bv["x"] < 4
+
+
+def test_array_cell_patch():
+    from mythril_tpu.smt import terms as T
+
+    arr = T.array_var("cd", 256, 8)
+    sel = T.mk_select(arr, T.bv_const(0, 256))
+    donor = _model(arrays={"cd": (0, {})})
+    term = T.mk_eq(T.mk_zext(248, sel), _c(0x2A).raw)
+    fixed = repair.try_repair(term, donor)
+    assert fixed is not None
+    assert fixed.raw[0].arrays["cd"][1][0] == 0x2A
+
+
+def test_storm_avoids_cdcl(monkeypatch):
+    """A 64-leaf sibling storm should reach the CDCL core O(1) times."""
+    from mythril_tpu.smt import Optimize
+
+    calls = {"n": 0}
+    orig = Optimize.check
+
+    def counting_check(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(Optimize, "check", counting_check)
+    words = [_bv(f"w{i}") for i in range(6)]
+    get_model.cache_clear()
+    repair.STATS["attempts"] = repair.STATS["repaired"] = 0
+    for leaf in range(64):
+        cons = tuple(
+            (w & 1) == ((leaf >> i) & 1) for i, w in enumerate(words)
+        )
+        m = get_model(cons)
+        for i, w in enumerate(words):
+            assert m.raw[0].eval_term((w & 1).raw) == (leaf >> i) & 1
+    assert repair.STATS["repaired"] >= 60
+    assert calls["n"] <= 4  # the seed solve plus stragglers at most
