@@ -220,12 +220,16 @@ def _bv_val(v: int) -> BitVec:
     return symbol_factory.BitVecVal(v, 256)
 
 
-def _coarse_bucket(k: int, cap: int, floor: int) -> int:
-    """Two-point bucket {floor, cap}: every distinct shape tuple is a
-    separate XLA compile (expensive through a tunneled backend), so the
-    column-clipping dims use at most two sizes — the padding waste is
-    bounded and the compile count stays O(1) per engine config."""
-    return min(cap, floor) if k <= floor else cap
+def _geo_bucket(k: int, cap: int, floor: int) -> int:
+    """Power-of-two bucket {floor, 2*floor, ..., cap} for the
+    escalation-retire dims: that gather is a SMALL graph (seconds to
+    compile, vs ~25 s for the fused window), and two-point bucketing
+    made a 12-slot batch pull 64-slot rows — on a ~10 MB/s tunnel the
+    padding bytes dwarf a rare extra compile."""
+    b = min(cap, floor)
+    while b < min(k, cap):
+        b *= 2
+    return min(b, cap)
 
 
 # ---- fused per-window device calls (one dispatch each; every extra
@@ -243,9 +247,14 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
     entries hold n -> dropped) from packed host arrays, and refresh the
     free-slot stack. Mid-path states (host spill/refill, ROADMAP
     mid-state re-seeding) arrive with nonzero pc/sp/stack/memory
-    columns."""
+    columns. The stack/memory/calldata arrays are SEED_*-narrow: the
+    row is zeroed, then the narrow prefix written (states deeper than
+    the seed caps never reach the device — lane_seedable)."""
     k = idx.shape[0]
     n_env = st.env.shape[1]
+    sd = stack_s.shape[1]
+    mc = mem_v.shape[1]
+    ccw = u8p.shape[1]
 
     def zero(plane):
         return plane.at[idx].set(0, mode="drop")
@@ -266,12 +275,15 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
         sp=st.sp.at[idx].set(sp, mode="drop"),
         depth=zero(st.depth),
         group=st.group.at[idx].set(group, mode="drop"),
-        ssid=st.ssid.at[idx].set(stack_s, mode="drop"),
-        stack=st.stack.at[idx].set(
-            stack_v.reshape(k, st.stack.shape[1], bv256.NLIMBS),
-            mode="drop"),
-        memory=st.memory.at[idx].set(mem_v, mode="drop"),
-        mkind=st.mkind.at[idx].set(mem_k, mode="drop"),
+        ssid=st.ssid.at[idx].set(0, mode="drop")
+        .at[idx, :sd].set(stack_s, mode="drop"),
+        stack=st.stack.at[idx].set(0, mode="drop")
+        .at[idx, :sd].set(
+            stack_v.reshape(k, sd, bv256.NLIMBS), mode="drop"),
+        memory=st.memory.at[idx].set(0, mode="drop")
+        .at[idx, :mc].set(mem_v, mode="drop"),
+        mkind=st.mkind.at[idx].set(0, mode="drop")
+        .at[idx, :mc].set(mem_k, mode="drop"),
         msize=st.msize.at[idx].set(msize, mode="drop"),
         mlog_count=zero(st.mlog_count),
         sval_sid=zero(st.sval_sid),
@@ -288,7 +300,8 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
         last_jump=st.last_jump.at[idx].set(-1, mode="drop"),
         status=st.status.at[idx].set(Status.RUNNING, mode="drop"),
         sbase=st.sbase.at[idx].set(sbase, mode="drop"),
-        calldata=st.calldata.at[idx].set(u8p, mode="drop"),
+        calldata=st.calldata.at[idx].set(0, mode="drop")
+        .at[idx, :ccw].set(u8p, mode="drop"),
         cd_size=st.cd_size.at[idx].set(cd_size, mode="drop"),
         cd_sym=st.cd_sym.at[idx].set(cd_sym, mode="drop"),
         cd_size_sid=st.cd_size_sid.at[idx].set(cd_size_sid,
@@ -560,19 +573,24 @@ def _gather_full_flog(st: SymLaneState):
     return _fork_table(st, st.flog_parent.shape[0])
 
 
-def _remap_reset_core(st: SymLaneState, prov_arr) -> SymLaneState:
+def _remap_reset_core(st: SymLaneState, prov_pairs) -> SymLaneState:
     """Remap provisional sids to resolved object ids (device-side — the
     sid planes never leave the device) and reset the per-window logs.
     Runs at the START of the next window's fused dispatch: the encoding
     (lane, record-slot) of the previous window's log is still unique
     until that window's run mints new records, and rows that retired in
     between are dead (their planes are never read again). The
-    resolution table arrives as a dense (N, R) i32 plane (16 KB at the
-    corpus config — a fixed shape, where a sparse triplet bucket would
-    fork a fresh multi-second jit variant on a record-heavy window).
-    Unresolved slots hold int32 min so a leaked sid fails loudly
-    instead of aliasing a real record."""
+    resolutions arrive as sparse (encoded-slot, oid) pairs — a dense
+    (N, R) plane cost 1 MB of transfer per window at 4096 lanes — and
+    are scattered into the dense table here (padding pairs carry an
+    out-of-range slot and drop). Unresolved slots hold int32 min so a
+    leaked sid fails loudly instead of aliasing a real record."""
     d_recs = st.dlog_op.shape[1]
+    n = st.pc.shape[0]
+    dense = jnp.full((n * d_recs,), np.iinfo(np.int32).min, jnp.int32)
+    dense = dense.at[prov_pairs[:, 0]].set(prov_pairs[:, 1],
+                                           mode="drop")
+    prov_arr = dense.reshape(n, d_recs)
 
     def remap(plane):
         negm = plane < 0
@@ -596,6 +614,21 @@ def _remap_reset_core(st: SymLaneState, prov_arr) -> SymLaneState:
 RCAP = 16
 RETIRE_FLOORS = (24, 512, 8, 8)
 
+#: device-seed column caps: a seed row ships only this much stack /
+#: concrete-memory / concrete-calldata content per lane. States past a
+#: cap stay on the host interpreter (lane_seedable) — a dense full-width
+#: seed buffer cost ~44 MB per 4096-lane window on a ~10 MB/s tunneled
+#: link, and mid-path states this deep are rare enough that host
+#: execution is cheaper than shipping them
+SEED_STACK = 16
+SEED_MEM = 256
+SEED_CD = 160
+#: provisional-sid resolutions ship as sparse (encoded-slot, oid) pairs
+#: scattered into the dense table on device; this bucket covers every
+#: realistic window (records/window is bounded by the drain), and only
+#: a pathological >PROV_BUCKET window compiles the dense-sized variant
+PROV_BUCKET = 4096
+
 
 def _unpack_i32_sections(buf, sections):
     """Split a flat i32 buffer into named (shape, dtype) sections
@@ -615,31 +648,32 @@ def _unpack_i32_sections(buf, sections):
     return out
 
 
-def _seed_sections(n, k, n_env, n_depth, d_recs):
+def _seed_sections(n, k, n_env, sd, pv):
     """Layout of the packed per-window i32 buffer (host+device agree).
     The kill section is lane-count-sized so a window can never overflow
     it — a capped bucket would let a dead-but-running lane's slot be
     re-seeded before its deferred kill lands. One layout serves fresh
     AND mid-path seeds (fresh rows carry zero stack/memory sections):
     a second jit variant costs ~25 s of compile on the tunneled
-    backend, the extra padding costs ~40 ms per window."""
+    backend, the extra padding costs little at SEED_* widths."""
     return [
         ("idx", (k,), jnp.int32),
         ("i32p", (k, 8 + n_env), jnp.int32),
         ("u32p", (k, 1 + n_env * bv256.NLIMBS), jnp.uint32),
         ("fs", (n,), jnp.int32),
         ("fcount", (), jnp.int32),
-        ("prov", (n, d_recs), jnp.int32),
+        ("prov", (pv, 2), jnp.int32),
         ("kill", (n,), jnp.int32),
-        ("stack_v", (k, n_depth * bv256.NLIMBS), jnp.uint32),
-        ("stack_s", (k, n_depth), jnp.int32),
+        ("stack_v", (k, sd * bv256.NLIMBS), jnp.uint32),
+        ("stack_s", (k, sd), jnp.int32),
     ]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,),
-                   static_argnums=tuple(range(6, 9)))
+                   static_argnums=tuple(range(6, 10)))
 def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
-                 taint_table, window: int, k: int, budget: int):
+                 taint_table, window: int, k: int, budget: int,
+                 pv: int = PROV_BUCKET):
     """The whole per-window device work in ONE dispatch with TWO packed
     host->device buffers — on a tunneled backend every dispatch is a
     full round trip and every input array is a separately-latencied
@@ -668,13 +702,16 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     n_depth = st.stack.shape[1]
     mem_cap = st.memory.shape[1]
     d_recs = st.dlog_op.shape[1]
-    sec = _seed_sections(n, k, n_env, n_depth, d_recs)
+    sd = min(SEED_STACK, n_depth)
+    mc = min(SEED_MEM, mem_cap)
+    ccw = min(SEED_CD, cap)
+    sec = _seed_sections(n, k, n_env, sd, pv)
     a = _unpack_i32_sections(i32buf, sec)
     stack_v, stack_s = a["stack_v"], a["stack_s"]
-    u8p = u8buf[:k * cap].reshape(k, cap)
-    mem_v = u8buf[k * cap:k * (cap + mem_cap)].reshape(k, mem_cap)
-    mem_k = u8buf[k * (cap + mem_cap):
-                  k * (cap + 2 * mem_cap)].reshape(k, mem_cap)
+    u8p = u8buf[:k * ccw].reshape(k, ccw)
+    mem_v = u8buf[k * ccw:k * (ccw + mc)].reshape(k, mc)
+    mem_k = u8buf[k * (ccw + mc):
+                  k * (ccw + 2 * mc)].reshape(k, mc)
 
     st = _remap_reset_core(st, a["prov"])
     st = st._replace(status=st.status.at[a["kill"]].set(
@@ -721,15 +758,18 @@ def _limbs_int(limbs) -> int:
     return bv256.limbs_to_int(np.asarray(limbs))
 
 
-def lane_seedable(gs, stack_depth: int = 64,
-                  memory_bytes: int = 4096,
+def lane_seedable(gs, stack_depth: int = SEED_STACK,
+                  memory_bytes: int = SEED_MEM,
                   exec_table=None) -> bool:
     """True when the lane engine can seed this state: tx-entry states
     and mid-path states with device-representable stack/memory (the
     host spill/refill path — over-capacity forks park to the host and
     their descendants re-enter the device here). Mid-path limits:
-    every stack item is an int/term, memory bytes are concrete, and the
-    state advanced past the instruction it parked at."""
+    every stack item is an int/term, memory bytes are concrete, the
+    state advanced past the instruction it parked at, and the
+    stack/memory content fits the SEED_* columns of the packed seed
+    buffer (deeper states stay on the host — shipping full-width seed
+    planes cost more tunnel time than the interpretation they saved)."""
     from .transaction import MessageCallTransaction
 
     ms = gs.mstate
@@ -862,20 +902,20 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
     # dummy code at the bucket length: shared across warms of the bucket
     cc = _compiled_code(b"\x00" * _code_bucket(max(code_len, 1)), ())
     big = seed_bucket > min(16, n_lanes)
-    i32buf, u8buf, k = eng._pack_window(
+    i32buf, u8buf, k, pv = eng._pack_window(
         [], [None] * n_lanes, list(range(n_lanes)), [],
         int(st.calldata.shape[1]), big=big)
     st, out = _window_exec(
         st, cc, i32buf, u8buf, eng.exec_table, eng.taint_table,
-        window, k, step_budget)
+        window, k, step_budget, pv)
     jax.block_until_ready(out)
     if not big:
         # escalation variants this engine config can hit mid-explore
         jax.block_until_ready(_unique_table_big(st))
         jax.block_until_ready(_gather_full_flog(st))
-        ridx = jnp.full(_coarse_bucket(1, n_lanes, min(64, n_lanes)),
+        ridx = jnp.full(_geo_bucket(1, n_lanes, min(64, n_lanes)),
                         n_lanes, jnp.int32)
-        st, rows = _retire_rows(st, ridx, 16, 512, 8, 8)
+        st, rows = _retire_rows(st, ridx, 8, 64, 8, 8)
         jax.block_until_ready(rows)
     eng._release_state(st)
 
@@ -1088,7 +1128,8 @@ class LaneEngine:
             isinstance(calldata, ConcreteCalldata)
             and all(isinstance(x, int)
                     for x in calldata._concrete_calldata)
-            and len(calldata._concrete_calldata) <= calldata_cap
+            and len(calldata._concrete_calldata)
+            <= min(calldata_cap, SEED_CD)
         )
 
         gas0_min, gas0_max = ms.min_gas_used, ms.max_gas_used
@@ -1147,6 +1188,12 @@ class LaneEngine:
         # svm.lane_seedable)
         n_depth = self.lane_kwargs.get("stack_depth", 64)
         mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
+        if len(ms.stack) > min(SEED_STACK, n_depth) or int(
+            ms.memory_size
+        ) > min(SEED_MEM, mem_cap):
+            # callers gate on lane_seedable; packing would silently
+            # truncate a deeper state into wrong execution
+            raise ValueError("seed exceeds SEED_STACK/SEED_MEM columns")
         byte_pc = 0
         if ms.pc:
             byte_pc = ilist[ms.pc]["address"]
@@ -1202,6 +1249,9 @@ class LaneEngine:
         n_depth = self.lane_kwargs.get("stack_depth", 64)
         mem_cap = self.lane_kwargs.get("memory_bytes", 4096)
         d_recs = self.lane_kwargs.get("dlog_records", 64)
+        sd = min(SEED_STACK, n_depth)
+        mc = min(SEED_MEM, mem_cap)
+        ccw = min(SEED_CD, calldata_cap)
         # two seed buckets only: the small one covers the common
         # trickle (always compiled — a second jit variant costs far
         # more than all-padding seed sections); the full-width one
@@ -1214,11 +1264,11 @@ class LaneEngine:
         idx[: len(lanes)] = lanes
         i32p = np.zeros((k, 8 + n_env), np.int32)
         u32p = np.zeros((k, 1 + n_env * bv256.NLIMBS), np.uint32)
-        u8p = np.zeros((k, calldata_cap), np.uint8)
-        stack_v = np.zeros((k, n_depth * bv256.NLIMBS), np.uint32)
-        stack_s = np.zeros((k, n_depth), np.int32)
-        mem_v = np.zeros((k, mem_cap), np.uint8)
-        mem_k = np.zeros((k, mem_cap), np.uint8)
+        u8p = np.zeros((k, ccw), np.uint8)
+        stack_v = np.zeros((k, sd * bv256.NLIMBS), np.uint32)
+        stack_s = np.zeros((k, sd), np.int32)
+        mem_v = np.zeros((k, mc), np.uint8)
+        mem_k = np.zeros((k, mc), np.uint8)
         for i, s in enumerate(specs):
             i32p[i, 0] = s["sbase"]
             i32p[i, 1] = s["cd_size"]
@@ -1231,23 +1281,27 @@ class LaneEngine:
             i32p[i, 8:] = s["env_sid"]
             u32p[i, 0] = s["gas_limit"]
             u32p[i, 1:] = s["env"].reshape(-1)
-            u8p[i] = s["calldata"]
-            stack_v[i] = s["stack_v"].reshape(-1)
-            stack_s[i] = s["stack_s"]
-            mem_v[i] = s["mem_v"]
-            mem_k[i] = s["mem_k"]
+            u8p[i] = s["calldata"][:ccw]
+            stack_v[i] = s["stack_v"][:sd].reshape(-1)
+            stack_s[i] = s["stack_s"][:sd]
+            mem_v[i] = s["mem_v"][:mc]
+            mem_k[i] = s["mem_k"][:mc]
         fs = np.zeros(n, np.int32)
         fs[: len(free)] = free
-        prov_arr = np.full((n, d_recs), np.iinfo(np.int32).min,
-                           np.int32)
-        for (lane, slot), oid in self._prov.items():
-            prov_arr[lane, slot] = oid
+        # sparse provisional-sid resolutions: padding pairs hold an
+        # out-of-range encoded slot (dropped by the device scatter)
+        pv = min(PROV_BUCKET, n * d_recs) \
+            if len(self._prov) <= PROV_BUCKET else n * d_recs
+        prov_pairs = np.full((pv, 2), n * d_recs, np.int32)
+        for j, ((lane, slot), oid) in enumerate(self._prov.items()):
+            prov_pairs[j, 0] = lane * d_recs + slot
+            prov_pairs[j, 1] = oid
         kl = np.full(n, n, np.int32)
         kl[: len(kill)] = kill
 
         parts = [idx, i32p.reshape(-1), u32p.reshape(-1).view(np.int32),
                  fs, np.array([len(free)], np.int32),
-                 prov_arr.reshape(-1), kl,
+                 prov_pairs.reshape(-1), kl,
                  stack_v.reshape(-1).view(np.int32),
                  stack_s.reshape(-1)]
         i32buf = np.concatenate([np.ascontiguousarray(p, np.int32)
@@ -1258,7 +1312,7 @@ class LaneEngine:
         self.stats["seeded"] += len(entries)
         # mid-path re-entries (the spill/refill path) vs fresh tx seeds
         self.stats["reseeded"] += sum(1 for s in specs if s["pc"])
-        return (jnp.asarray(i32buf), jnp.asarray(u8buf), k)
+        return (jnp.asarray(i32buf), jnp.asarray(u8buf), k, pv)
 
     # -- drain ---------------------------------------------------------------
 
@@ -1655,7 +1709,7 @@ class LaneEngine:
                     results.append(gs)  # host handles this entry
                     continue
                 entries.append((free.pop(), gs))
-            i32buf, u8buf, k = self._pack_window(
+            i32buf, u8buf, k, pv = self._pack_window(
                 entries, ctxs, free, kill, calldata_cap,
                 big=seed_cap > small)
             n_free_written = len(free)
@@ -1664,7 +1718,7 @@ class LaneEngine:
                 st, out = _window_exec(
                     st, cc, i32buf, u8buf, self.exec_table,
                     self.taint_table, self.window, k,
-                    self.step_budget)
+                    self.step_budget, pv)
             # the kill landed at the dispatch's reset phase: only now
             # may the slots be recycled (they enter the free stack the
             # device sees at the NEXT dispatch)
@@ -1759,20 +1813,20 @@ class LaneEngine:
                 c = counts_h
                 rsel = np.asarray(rest, np.int32)
                 lk = self.lane_kwargs
-                dstack = _coarse_bucket(
+                dstack = _geo_bucket(
                     max(int(c["sp"][rsel].max()), 1),
-                    lk.get("stack_depth", 64), 16)
-                dmem = _coarse_bucket(
+                    lk.get("stack_depth", 64), 8)
+                dmem = _geo_bucket(
                     max(int(c["msize"][rsel].max()), 1),
-                    lk.get("memory_bytes", 4096), 512)
-                dmlog = _coarse_bucket(
+                    lk.get("memory_bytes", 4096), 64)
+                dmlog = _geo_bucket(
                     max(int(c["mlog_count"][rsel].max()), 1),
                     lk.get("mem_records", 64), 8)
-                dslot = _coarse_bucket(
+                dslot = _geo_bucket(
                     max(int(c["scount"][rsel].max()), 1),
                     lk.get("storage_slots", 64), 8)
-                kr = _coarse_bucket(len(rest), self.n_lanes,
-                                    min(64, self.n_lanes))
+                kr = _geo_bucket(len(rest), self.n_lanes,
+                                 min(64, self.n_lanes))
                 ridx2 = np.full(kr, self.n_lanes, np.int32)
                 ridx2[: len(rest)] = rest
                 with _prof("retire_pull"):
