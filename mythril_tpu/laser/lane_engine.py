@@ -669,11 +669,11 @@ def _seed_sections(n, k, n_env, sd, pv):
     ]
 
 
-@functools.partial(jax.jit, donate_argnums=(0,),
+@functools.partial(jax.jit, donate_argnums=(0, 10),
                    static_argnums=tuple(range(6, 10)))
 def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
                  taint_table, window: int, k: int, budget: int,
-                 pv: int = PROV_BUCKET):
+                 pv: int, visited):
     """The whole per-window device work in ONE dispatch with TWO packed
     host->device buffers — on a tunneled backend every dispatch is a
     full round trip and every input array is a separately-latencied
@@ -719,7 +719,8 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     st = _prologue_core(st, a["idx"], a["i32p"], a["u32p"], u8p,
                         stack_v, stack_s, mem_v, mem_k, a["fs"],
                         a["fcount"])
-    st = symstep.sym_run(cc, st, window, exec_table, taint_table)
+    st, visited = symstep.sym_run(cc, st, window, exec_table,
+                                  taint_table, visited)
 
     # 4. canonicalize records; planes reference canonical pids only
     dlog_sid2, canon_pid = _dedup_canon(st, d_recs)
@@ -751,7 +752,7 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
                                                            n * d_recs))
     ftab = _fork_table(st, min(FB, n))
     scal = jnp.concatenate([scal, ucount[None]])
-    return st, (misc, scal, utab, ftab, ridx) + rows
+    return st, visited, (misc, scal, utab, ftab, ridx) + rows
 
 
 def _limbs_int(limbs) -> int:
@@ -905,9 +906,10 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
     i32buf, u8buf, k, pv = eng._pack_window(
         [], [None] * n_lanes, list(range(n_lanes)), [],
         int(st.calldata.shape[1]), big=big)
-    st, out = _window_exec(
+    visited = jnp.zeros(cc.packed.shape[0], bool)
+    st, visited, out = _window_exec(
         st, cc, i32buf, u8buf, eng.exec_table, eng.taint_table,
-        window, k, step_budget, pv)
+        window, k, step_budget, pv, visited)
     jax.block_until_ready(out)
     if not big:
         # escalation variants this engine config can hit mid-explore
@@ -1016,6 +1018,9 @@ class LaneEngine:
         self.window = window
         self.step_budget = step_budget
         self.lane_kwargs = lane_kwargs
+        #: device-resident / host coverage bitmaps per code (see explore)
+        self._visited_dev: Dict[bytes, object] = {}
+        self.visited_by_code: Dict[bytes, np.ndarray] = {}
         # opcodes with registered detector hooks must park so the hooks
         # fire host-side; remove them from the device-executable set.
         # Modules with a lane adapter (analysis/module/lane_adapters.py)
@@ -1669,7 +1674,6 @@ class LaneEngine:
         """Run entry states on device until every path parks or dies;
         returns the materialized parked states (each positioned at the
         first instruction the device could not execute)."""
-        import jax
 
         self._func_names = dict(
             getattr(entry_states[0].environment.code,
@@ -1677,6 +1681,13 @@ class LaneEngine:
         ) if entry_states else {}
         stats0 = dict(self.stats)  # engines persist across explores
         cc = _compiled_code(code_bytes, self._func_names.keys())
+        # per-byte-address coverage bitmap, device-resident across
+        # windows AND explores of the same code (the interpreter's
+        # execute_state coverage hook cannot see device steps; this is
+        # its device twin — svm merges it into the coverage plugin)
+        visited = self._visited_dev.pop(code_bytes, None)
+        if visited is None:
+            visited = jnp.zeros(cc.packed.shape[0], bool)
         st = self._acquire_state()
         ctxs: List[Optional[LaneCtx]] = [None] * self.n_lanes
         queue = deque(entry_states)
@@ -1684,177 +1695,188 @@ class LaneEngine:
         results: List[GlobalState] = []
         calldata_cap = int(st.calldata.shape[1])
         n = self.n_lanes
-        import jax.numpy as jnp
 
         kill: List[int] = []
         small = min(16, self.n_lanes)
-        while True:
-            # a seed backlog beyond the small bucket drains in ONE
-            # window through the full-width midpath variant — but only
-            # once that variant is compiled (warm_variant kicks a
-            # background compile and the small bucket carries on)
-            seed_cap = small
-            if len(queue) > small and warm_variant(
-                self.n_lanes, len(code_bytes), self.lane_kwargs,
-                self.window, self.step_budget,
-                seed_bucket=self.n_lanes,
-            ):
-                seed_cap = self.n_lanes
-            entries = []
-            while queue and free and len(entries) < seed_cap:
-                gs = queue.popleft()
-                if self.adapters and not all(
-                    ad.seed_ok(gs) for ad in self.adapters
+        try:
+            while True:
+                # a seed backlog beyond the small bucket drains in ONE
+                # window through the full-width midpath variant — but only
+                # once that variant is compiled (warm_variant kicks a
+                # background compile and the small bucket carries on)
+                seed_cap = small
+                if len(queue) > small and warm_variant(
+                    self.n_lanes, len(code_bytes), self.lane_kwargs,
+                    self.window, self.step_budget,
+                    seed_bucket=self.n_lanes,
                 ):
-                    results.append(gs)  # host handles this entry
-                    continue
-                entries.append((free.pop(), gs))
-            i32buf, u8buf, k, pv = self._pack_window(
-                entries, ctxs, free, kill, calldata_cap,
-                big=seed_cap > small)
-            n_free_written = len(free)
-            _tw = time.perf_counter() if PROF_ON else 0.0
-            with _prof("window_exec", sync=lambda: st.pc):
-                st, out = _window_exec(
-                    st, cc, i32buf, u8buf, self.exec_table,
-                    self.taint_table, self.window, k,
-                    self.step_budget, pv)
-            # the kill landed at the dispatch's reset phase: only now
-            # may the slots be recycled (they enter the free stack the
-            # device sees at the NEXT dispatch)
-            for lane in kill:
-                ctxs[lane] = None
-                free.append(lane)
-            kill = []
-            if PROF_ON:
-                PROF.setdefault("windows", []).append(  # type: ignore
-                    (round(time.perf_counter() - _tw, 3), k,
-                     len(code_bytes)))
-            self.stats["windows"] += 1
-            with _prof("window_pull"):
-                (misc, scal, utab, ftab, ridx, r_i32, r_u32,
-                 r_u8) = [np.asarray(x) for x in jax.device_get(out)]
-            counts_h = {
-                "dlog_count": misc[:, 0], "status": misc[:, 1],
-                "steps": misc[:, 2], "sp": misc[:, 3],
-                "scount": misc[:, 4], "mlog_count": misc[:, 5],
-                "msize": misc[:, 6],
-                "flog_count": int(scal[0]),
-                "free_count": int(scal[1]),
-                "ucount": int(scal[2]),
-            }
-            self.last_counts = counts_h
-            nf = counts_h["flog_count"]
-            ucount = counts_h["ucount"]
-            if ucount > utab.shape[0]:
-                # rare: more distinct records than the table budget
-                with _prof("logs_escalate"):
-                    utab, uc2 = jax.device_get(_unique_table_big(st))
-                utab = np.asarray(utab)
-                ucount = int(uc2)
+                    seed_cap = self.n_lanes
+                entries = []
+                while queue and free and len(entries) < seed_cap:
+                    gs = queue.popleft()
+                    if self.adapters and not all(
+                        ad.seed_ok(gs) for ad in self.adapters
+                    ):
+                        results.append(gs)  # host handles this entry
+                        continue
+                    entries.append((free.pop(), gs))
+                i32buf, u8buf, k, pv = self._pack_window(
+                    entries, ctxs, free, kill, calldata_cap,
+                    big=seed_cap > small)
+                n_free_written = len(free)
+                _tw = time.perf_counter() if PROF_ON else 0.0
+                with _prof("window_exec", sync=lambda: st.pc):
+                    st, visited, out = _window_exec(
+                        st, cc, i32buf, u8buf, self.exec_table,
+                        self.taint_table, self.window, k,
+                        self.step_budget, pv, visited)
+                # the kill landed at the dispatch's reset phase: only now
+                # may the slots be recycled (they enter the free stack the
+                # device sees at the NEXT dispatch)
+                for lane in kill:
+                    ctxs[lane] = None
+                    free.append(lane)
+                kill = []
+                if PROF_ON:
+                    PROF.setdefault("windows", []).append(  # type: ignore
+                        (round(time.perf_counter() - _tw, 3), k,
+                         len(code_bytes)))
+                self.stats["windows"] += 1
+                with _prof("window_pull"):
+                    (misc, scal, utab, ftab, ridx, r_i32, r_u32,
+                     r_u8) = [np.asarray(x) for x in jax.device_get(out)]
+                counts_h = {
+                    "dlog_count": misc[:, 0], "status": misc[:, 1],
+                    "steps": misc[:, 2], "sp": misc[:, 3],
+                    "scount": misc[:, 4], "mlog_count": misc[:, 5],
+                    "msize": misc[:, 6],
+                    "flog_count": int(scal[0]),
+                    "free_count": int(scal[1]),
+                    "ucount": int(scal[2]),
+                }
+                self.last_counts = counts_h
+                nf = counts_h["flog_count"]
+                ucount = counts_h["ucount"]
                 if ucount > utab.shape[0]:
-                    raise RuntimeError(
-                        f"{ucount} distinct records in one window "
-                        f"exceed the escalation budget")
-            recs = []
-            for i in range(ucount):
-                row = utab[i]
-                recs.append((
-                    int(row[4]), int(row[0]), int(row[1]), int(row[2]),
-                    int(row[3]), int(row[5]),
-                    (int(row[6]), int(row[7]), int(row[8])),
-                    np.ascontiguousarray(row[9:]).view(np.uint32)
-                    .reshape(3, bv256.NLIMBS),
-                ))
-            if nf > ftab.shape[0]:
-                with _prof("flog_escalate"):
-                    ftab = np.asarray(jax.device_get(
-                        _gather_full_flog(st)))
-            forks = []
-            for i in range(nf):
-                r = ftab[i]
-                forks.append((
-                    int(r[2]), int(r[0]), int(r[1]), int(r[3]),
-                    int(r[4]), int(np.uint32(r[5])),
-                    int(np.uint32(r[6])), int(r[7]), int(r[8]),
-                ))
-            self._prov, dead = self._drain_host(recs, forks, ctxs)
-            status = counts_h["status"].copy()
-            steps = counts_h["steps"]
-            # forked children consumed slots from the top (tail) of the
-            # free stack; reconcile before re-seeding
-            consumed = n_free_written - counts_h["free_count"]
-            if consumed:
-                free = free[: n_free_written - consumed]
+                    # rare: more distinct records than the table budget
+                    with _prof("logs_escalate"):
+                        utab, uc2 = jax.device_get(_unique_table_big(st))
+                    utab = np.asarray(utab)
+                    ucount = int(uc2)
+                    if ucount > utab.shape[0]:
+                        raise RuntimeError(
+                            f"{ucount} distinct records in one window "
+                            f"exceed the escalation budget")
+                recs = []
+                for i in range(ucount):
+                    row = utab[i]
+                    recs.append((
+                        int(row[4]), int(row[0]), int(row[1]), int(row[2]),
+                        int(row[3]), int(row[5]),
+                        (int(row[6]), int(row[7]), int(row[8])),
+                        np.ascontiguousarray(row[9:]).view(np.uint32)
+                        .reshape(3, bv256.NLIMBS),
+                    ))
+                if nf > ftab.shape[0]:
+                    with _prof("flog_escalate"):
+                        ftab = np.asarray(jax.device_get(
+                            _gather_full_flog(st)))
+                forks = []
+                for i in range(nf):
+                    r = ftab[i]
+                    forks.append((
+                        int(r[2]), int(r[0]), int(r[1]), int(r[3]),
+                        int(r[4]), int(np.uint32(r[5])),
+                        int(np.uint32(r[6])), int(r[7]), int(r[8]),
+                    ))
+                self._prov, dead = self._drain_host(recs, forks, ctxs)
+                status = counts_h["status"].copy()
+                steps = counts_h["steps"]
+                # forked children consumed slots from the top (tail) of the
+                # free stack; reconcile before re-seeding
+                consumed = n_free_written - counts_h["free_count"]
+                if consumed:
+                    free = free[: n_free_written - consumed]
 
-            dead_set = set(dead)
-            # 1. fast-retired lanes: the window dispatch already
-            # gathered their rows and marked them DEAD (ridx row i is
-            # the i-th retired lane; padding entries hold n)
-            fast = [int(x) for x in ridx if x < n]
-            if fast:
-                st_fast = _unpack_rows((r_i32, r_u32, r_u8),
-                                       *RETIRE_FLOORS)
-                with _prof("materialize"):
-                    for row, lane in enumerate(fast):
-                        self.stats["device_steps"] += int(steps[lane])
-                        if lane not in dead_set:
-                            results.append(self.materialize(
-                                st_fast, row, ctxs[lane]))
-                        ctxs[lane] = None
-                        free.append(lane)
-            # 2. escalation: parked lanes past the fast budget or over
-            # a column floor (status still NEEDS_HOST), plus runaways
-            runaway = (status == Status.RUNNING) \
-                & (steps >= self.step_budget)
-            rest = np.nonzero(
-                (status == Status.NEEDS_HOST) | runaway)[0].tolist()
-            if rest:
-                c = counts_h
-                rsel = np.asarray(rest, np.int32)
-                lk = self.lane_kwargs
-                dstack = _geo_bucket(
-                    max(int(c["sp"][rsel].max()), 1),
-                    lk.get("stack_depth", 64), 8)
-                dmem = _geo_bucket(
-                    max(int(c["msize"][rsel].max()), 1),
-                    lk.get("memory_bytes", 4096), 64)
-                dmlog = _geo_bucket(
-                    max(int(c["mlog_count"][rsel].max()), 1),
-                    lk.get("mem_records", 64), 8)
-                dslot = _geo_bucket(
-                    max(int(c["scount"][rsel].max()), 1),
-                    lk.get("storage_slots", 64), 8)
-                kr = _geo_bucket(len(rest), self.n_lanes,
-                                 min(64, self.n_lanes))
-                ridx2 = np.full(kr, self.n_lanes, np.int32)
-                ridx2[: len(rest)] = rest
-                with _prof("retire_pull"):
-                    st, rows = _retire_rows(st, jnp.asarray(ridx2),
-                                            dstack, dmem, dmlog, dslot)
-                    st_host = _unpack_rows(jax.device_get(rows),
-                                           dstack, dmem, dmlog, dslot)
-                with _prof("materialize"):
-                    for row, lane in enumerate(rest):
-                        self.stats["device_steps"] += int(steps[lane])
-                        if lane not in dead_set:
-                            results.append(self.materialize(
-                                st_host, row, ctxs[lane]))
-                        ctxs[lane] = None
-                        free.append(lane)
-                status[rsel] = DEAD
-            # 3. trivially-false lanes still RUNNING on device: kill
-            # them at the next dispatch (before it seeds anything) and
-            # recycle their slots after it. Their host status stays
-            # RUNNING so the loop always runs that dispatch.
-            retired = set(fast) | set(rest)
-            for lane in dead:
-                if lane not in retired:
-                    kill.append(lane)
+                dead_set = set(dead)
+                # 1. fast-retired lanes: the window dispatch already
+                # gathered their rows and marked them DEAD (ridx row i is
+                # the i-th retired lane; padding entries hold n)
+                fast = [int(x) for x in ridx if x < n]
+                if fast:
+                    st_fast = _unpack_rows((r_i32, r_u32, r_u8),
+                                           *RETIRE_FLOORS)
+                    with _prof("materialize"):
+                        for row, lane in enumerate(fast):
+                            self.stats["device_steps"] += int(steps[lane])
+                            if lane not in dead_set:
+                                results.append(self.materialize(
+                                    st_fast, row, ctxs[lane]))
+                            ctxs[lane] = None
+                            free.append(lane)
+                # 2. escalation: parked lanes past the fast budget or over
+                # a column floor (status still NEEDS_HOST), plus runaways
+                runaway = (status == Status.RUNNING) \
+                    & (steps >= self.step_budget)
+                rest = np.nonzero(
+                    (status == Status.NEEDS_HOST) | runaway)[0].tolist()
+                if rest:
+                    c = counts_h
+                    rsel = np.asarray(rest, np.int32)
+                    lk = self.lane_kwargs
+                    dstack = _geo_bucket(
+                        max(int(c["sp"][rsel].max()), 1),
+                        lk.get("stack_depth", 64), 8)
+                    dmem = _geo_bucket(
+                        max(int(c["msize"][rsel].max()), 1),
+                        lk.get("memory_bytes", 4096), 64)
+                    dmlog = _geo_bucket(
+                        max(int(c["mlog_count"][rsel].max()), 1),
+                        lk.get("mem_records", 64), 8)
+                    dslot = _geo_bucket(
+                        max(int(c["scount"][rsel].max()), 1),
+                        lk.get("storage_slots", 64), 8)
+                    kr = _geo_bucket(len(rest), self.n_lanes,
+                                     min(64, self.n_lanes))
+                    ridx2 = np.full(kr, self.n_lanes, np.int32)
+                    ridx2[: len(rest)] = rest
+                    with _prof("retire_pull"):
+                        st, rows = _retire_rows(st, jnp.asarray(ridx2),
+                                                dstack, dmem, dmlog, dslot)
+                        st_host = _unpack_rows(jax.device_get(rows),
+                                               dstack, dmem, dmlog, dslot)
+                    with _prof("materialize"):
+                        for row, lane in enumerate(rest):
+                            self.stats["device_steps"] += int(steps[lane])
+                            if lane not in dead_set:
+                                results.append(self.materialize(
+                                    st_host, row, ctxs[lane]))
+                            ctxs[lane] = None
+                            free.append(lane)
+                    status[rsel] = DEAD
+                # 3. trivially-false lanes still RUNNING on device: kill
+                # them at the next dispatch (before it seeds anything) and
+                # recycle their slots after it. Their host status stays
+                # RUNNING so the loop always runs that dispatch.
+                retired = set(fast) | set(rest)
+                for lane in dead:
+                    if lane not in retired:
+                        kill.append(lane)
 
-            running = int(np.sum(status == Status.RUNNING))
-            if not running and not queue:
-                break
+                running = int(np.sum(status == Status.RUNNING))
+                if not running and not queue:
+                    break
+        finally:
+            # an exception mid-sweep (svm falls back to the host)
+            # must not lose coverage accumulated in prior windows;
+            # a donated-then-failed dispatch can leave the bitmap
+            # deleted, in which case drop it rather than crash
+            try:
+                self._visited_dev[code_bytes] = visited
+                self.visited_by_code[code_bytes] = np.asarray(
+                    jax.device_get(visited))[: cc.size]
+            except Exception:
+                self._visited_dev.pop(code_bytes, None)
         self._release_state(st)
         global LAST_RUN_STATS
         delta = {k: v - stats0.get(k, 0) for k, v in self.stats.items()}

@@ -17,6 +17,9 @@ class LaserPluginLoader(object, metaclass=Singleton):
     def __init__(self) -> None:
         self.laser_plugin_builders: Dict[str, PluginBuilder] = {}
         self.plugin_args: Dict[str, Dict] = {}
+        #: instances built by the most recent instrument call, by name
+        #: (telemetry consumers read coverage/profile data back out)
+        self.plugin_instances: Dict[str, "LaserPlugin"] = {}
 
     def add_args(self, plugin_name: str, **kwargs) -> None:
         self.plugin_args[plugin_name] = kwargs
@@ -44,6 +47,7 @@ class LaserPluginLoader(object, metaclass=Singleton):
     def instrument_virtual_machine(self, symbolic_vm,
                                    with_plugins: Optional[List[str]]):
         """Install all enabled (or selected) plugins on the vm."""
+        self.plugin_instances.clear()
         for plugin_name, plugin_builder in self.laser_plugin_builders.items():
             if not plugin_builder.enabled:
                 continue
@@ -61,3 +65,4 @@ class LaserPluginLoader(object, metaclass=Singleton):
                 continue
             log.info("Loading laser plugin: %s", plugin_name)
             plugin.initialize(symbolic_vm)
+            self.plugin_instances[plugin_name] = plugin

@@ -97,6 +97,7 @@ class LaserEVM:
         self._start_exec_hooks: List[Callable] = []
         self._stop_exec_hooks: List[Callable] = []
         self._transaction_end_hooks: List[Callable] = []
+        self._lane_coverage_hooks: List[Callable] = []
 
         self.iprof = iprof
         self.instr_pre_hook: Dict[str, List[Callable]] = {}
@@ -116,6 +117,7 @@ class LaserEVM:
             "start_exec": self._start_exec_hooks,
             "stop_exec": self._stop_exec_hooks,
             "transaction_end": self._transaction_end_hooks,
+            "lane_coverage": self._lane_coverage_hooks,
         }
         log.info(
             "LASER EVM initialized with dynamic loader: %s", dynamic_loader
@@ -348,20 +350,24 @@ class LaserEVM:
         for name in blocked:
             if name in _opb:
                 table[_opb[name]] = False
-        eligible, rest = [], []
-        for gs in self.work_list:
+        code_of: Dict[int, bytes] = {}
+
+        def _device_ok(gs: GlobalState) -> bool:
             code = code_to_bytes(gs.environment.code)
             if code and lane_seedable(gs, exec_table=table):
-                eligible.append((code, gs))
-            else:
-                rest.append(gs)
-        if len(eligible) < min_batch:
+                code_of[id(gs)] = code
+                return True
+            return False
+
+        # count first, drain only on commitment: a drain-and-put-back
+        # would reorder the work list under the strategy
+        if sum(1 for gs in self.work_list if _device_ok(gs)) \
+                < min_batch:
             return  # device round trips don't pay for a trickle
+        eligible = self.strategy.drain_eligible(_device_ok)
         groups: Dict[bytes, List[GlobalState]] = {}
-        for code, gs in eligible:
-            groups.setdefault(code, []).append(gs)
-        del self.work_list[:]
-        self.work_list.extend(rest)
+        for gs in eligible:
+            groups.setdefault(code_of[id(gs)], []).append(gs)
         # engines persist across sweeps/transactions: the device state
         # pool, object table, and term memos all stay warm (a fresh
         # engine per sweep pays the init dispatch + cold caches)
@@ -398,6 +404,14 @@ class LaserEVM:
             run = engine.last_run_stats
             self.work_list.extend(parked)
             self.total_states += run["device_steps"]
+            # device-executed pcs are invisible to execute_state hooks;
+            # merge the engine's visited bitmap into coverage consumers
+            vis = engine.visited_by_code.get(code)
+            if vis is not None and self._lane_coverage_hooks:
+                env_code = states[0].environment.code
+                for hook in self._lane_coverage_hooks:
+                    hook(env_code.bytecode,
+                         env_code.instruction_list, vis)
             log.info(
                 "lane engine: %d entries -> %d parked states "
                 "(%d forks, %d device steps, %d records, %d windows)",

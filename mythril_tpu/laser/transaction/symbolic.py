@@ -1,138 +1,48 @@
-"""Symbolic transaction executors and the ACTORS registry (capability
-parity: mythril/laser/ethereum/transaction/symbolic.py:29-247)."""
+"""Symbolic transaction executors over entry waves (capability parity:
+mythril/laser/ethereum/transaction/symbolic.py:29-247 — redesigned
+wave-first; see transaction/entry.py for the planner)."""
 
 import logging
-from typing import List, Optional
 
 from ...disassembler.disassembly import Disassembly
-from ...smt import BitVec, Bool, Or, symbol_factory
-from ..cfg import Edge, JumpType, Node
-from ..state.account import Account
-from ..state.calldata import SymbolicCalldata
+from ...smt import BitVec, symbol_factory
 from ..state.world_state import WorldState
+from .entry import ACTORS, Actors, EntryWave, FUNCTION_HASH_BYTE_LENGTH
 from .transaction_models import (
-    BaseTransaction,
+    Account,
     ContractCreationTransaction,
-    MessageCallTransaction,
-    tx_id_manager,
 )
 
-FUNCTION_HASH_BYTE_LENGTH = 4
+__all__ = [
+    "ACTORS",
+    "Actors",
+    "FUNCTION_HASH_BYTE_LENGTH",
+    "execute_contract_creation",
+    "execute_message_call",
+    "execute_transaction",
+]
 
 log = logging.getLogger(__name__)
 
 
-class Actors:
-    """Named transaction senders used to constrain symbolic callers."""
-
-    def __init__(
-        self,
-        creator=0xAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFE,
-        attacker=0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF,
-        someguy=0xAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA,
-    ):
-        self.addresses = {
-            "CREATOR": symbol_factory.BitVecVal(creator, 256),
-            "ATTACKER": symbol_factory.BitVecVal(attacker, 256),
-            "SOMEGUY": symbol_factory.BitVecVal(someguy, 256),
-        }
-
-    def __setitem__(self, actor: str, address: Optional[str]):
-        if address is None:
-            if actor in ("CREATOR", "ATTACKER"):
-                raise ValueError(
-                    "Can't delete creator or attacker address"
-                )
-            del self.addresses[actor]
-            return
-        if address[0:2] != "0x":
-            raise ValueError("Actor address not in valid format")
-        self.addresses[actor] = symbol_factory.BitVecVal(
-            int(address[2:], 16), 256
-        )
-
-    def __getitem__(self, actor: str):
-        return self.addresses[actor]
-
-    @property
-    def creator(self):
-        return self.addresses["CREATOR"]
-
-    @property
-    def attacker(self):
-        return self.addresses["ATTACKER"]
-
-    def __len__(self):
-        return len(self.addresses)
-
-
-ACTORS = Actors()
-
-
-def generate_function_constraints(
-    calldata: SymbolicCalldata, func_hashes: List[List[int]]
-) -> List[Bool]:
-    """Constrain the selector bytes of calldata to the allowed function
-    hashes of this transaction (-1 = fallback, -2 = receive)."""
-    if len(func_hashes) == 0:
-        return []
-    constraints = []
-    for i in range(FUNCTION_HASH_BYTE_LENGTH):
-        constraint = symbol_factory.Bool(False)
-        for func_hash in func_hashes:
-            if func_hash == -1:
-                constraint = Or(constraint, calldata.size < 4)
-            elif func_hash == -2:
-                constraint = Or(constraint, calldata.size == 0)
-            else:
-                constraint = Or(
-                    constraint,
-                    calldata[i]
-                    == symbol_factory.BitVecVal(func_hash[i], 8),
-                )
-        constraints.append(constraint)
-    return constraints
-
-
 def execute_message_call(laser_evm, callee_address: BitVec,
                          func_hashes=None) -> None:
-    """Run one symbolic message call from every open world state."""
-    open_states = laser_evm.open_states[:]
+    """Plan one wave of symbolic message calls — one entry per open
+    world state whose callee is alive — then run the engine once over
+    the whole wave (the lane sweep flood-seeds it in one window)."""
+    states = laser_evm.open_states[:]
     del laser_evm.open_states[:]
 
-    for open_world_state in open_states:
-        if open_world_state[callee_address].deleted:
+    live = []
+    for ws in states:
+        if ws[callee_address].deleted:
             log.debug("Can not execute dead contract, skipping.")
             continue
+        live.append(ws)
 
-        next_transaction_id = tx_id_manager.get_next_tx_id()
-        external_sender = symbol_factory.BitVecSym(
-            "sender_{}".format(next_transaction_id), 256
-        )
-        calldata = SymbolicCalldata(next_transaction_id)
-        transaction = MessageCallTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=symbol_factory.BitVecSym(
-                "gas_price{}".format(next_transaction_id), 256
-            ),
-            gas_limit=8000000,  # block gas limit
-            origin=external_sender,
-            caller=external_sender,
-            callee_account=open_world_state[callee_address],
-            call_data=calldata,
-            call_value=symbol_factory.BitVecSym(
-                "call_value{}".format(next_transaction_id), 256
-            ),
-        )
-        constraints = (
-            generate_function_constraints(calldata, func_hashes)
-            if func_hashes
-            else None
-        )
-        _setup_global_state_for_execution(
-            laser_evm, transaction, constraints
-        )
+    wave = EntryWave(laser_evm, len(live), func_hashes)
+    for i, ws in enumerate(live):
+        wave.spawn_call(i, ws, ws[callee_address])
     laser_evm.exec()
 
 
@@ -145,76 +55,24 @@ def execute_contract_creation(
     caller=ACTORS["CREATOR"],
 ) -> Account:
     """Run the creation transaction; returns the new account."""
-    world_state = world_state or WorldState()
-    open_states = [world_state]
     del laser_evm.open_states[:]
-    new_account = None
-    for open_world_state in open_states:
-        next_transaction_id = tx_id_manager.get_next_tx_id()
-        transaction = ContractCreationTransaction(
-            world_state=open_world_state,
-            identifier=next_transaction_id,
-            gas_price=symbol_factory.BitVecSym(
-                "gas_price{}".format(next_transaction_id), 256
-            ),
-            gas_limit=8000000,  # block gas limit
-            origin=origin,
-            code=Disassembly(contract_initialization_code),
-            caller=caller,
-            contract_name=contract_name,
-            call_data=None,
-            call_value=symbol_factory.BitVecSym(
-                "call_value{}".format(next_transaction_id), 256
-            ),
-        )
-        _setup_global_state_for_execution(laser_evm, transaction)
-        new_account = new_account or transaction.callee_account
+    wave = EntryWave(laser_evm, 1)
+    tid = str(wave.base)
+    transaction = ContractCreationTransaction(
+        world_state=world_state or WorldState(),
+        identifier=tid,
+        gas_price=symbol_factory.BitVecSym(f"gas_price{tid}", 256),
+        gas_limit=8000000,  # block gas limit
+        origin=origin,
+        code=Disassembly(contract_initialization_code),
+        caller=caller,
+        contract_name=contract_name,
+        call_data=None,
+        call_value=symbol_factory.BitVecSym(f"call_value{tid}", 256),
+    )
+    wave.enqueue(transaction)
     laser_evm.exec(True)
-    return new_account
-
-
-def _setup_global_state_for_execution(
-    laser_evm, transaction: BaseTransaction,
-    initial_constraints=None,
-) -> None:
-    """Install the transaction's entry state on the worklist, constraining
-    the caller to the ACTORS set."""
-    global_state = transaction.initial_global_state()
-    global_state.transaction_stack.append((transaction, None))
-    global_state.world_state.constraints += initial_constraints or []
-
-    global_state.world_state.constraints.append(
-        Or(
-            *[
-                transaction.caller == actor
-                for actor in ACTORS.addresses.values()
-            ]
-        )
-    )
-
-    new_node = Node(
-        global_state.environment.active_account.contract_name,
-        function_name=global_state.environment.active_function_name,
-    )
-    if laser_evm.requires_statespace:
-        laser_evm.nodes[new_node.uid] = new_node
-
-    if transaction.world_state.node:
-        if laser_evm.requires_statespace:
-            laser_evm.edges.append(
-                Edge(
-                    transaction.world_state.node.uid,
-                    new_node.uid,
-                    edge_type=JumpType.Transaction,
-                    condition=None,
-                )
-            )
-        new_node.constraints = global_state.world_state.constraints
-
-    global_state.world_state.transaction_sequence.append(transaction)
-    global_state.node = new_node
-    new_node.states.append(global_state)
-    laser_evm.work_list.append(global_state)
+    return transaction.callee_account
 
 
 def execute_transaction(laser_evm, callee_address: str = "",

@@ -1,9 +1,13 @@
 """Transaction models and control-flow signals (capability parity:
-mythril/laser/ethereum/transaction/transaction_models.py:21-284)."""
+mythril/laser/ethereum/transaction/transaction_models.py:21-284 —
+restructured wave-first: ids come from a block-reserving manager so a
+whole entry wave shares one allocation, symbolic defaults are minted
+from a descriptor table, and both transaction kinds share a single
+entry-state spawner parameterized by an environment builder)."""
 
 import logging
 from copy import deepcopy
-from typing import Optional, Union
+from typing import Optional
 
 from ...smt import BitVec, UGE, symbol_factory
 from ...support.support_utils import Singleton
@@ -18,18 +22,28 @@ log = logging.getLogger(__name__)
 
 
 class TxIdManager(object, metaclass=Singleton):
-    def __init__(self):
-        self._next_transaction_id = 0
+    """Monotone transaction-id source. The wave-based entry layer
+    (transaction/entry.py) reserves CONTIGUOUS BLOCKS so one allocation
+    serves a whole wave of open states; single-id callers (CALL-family
+    sub-transactions, concolic replays) draw blocks of one."""
 
-    def get_next_tx_id(self):
-        self._next_transaction_id += 1
-        return str(self._next_transaction_id)
+    def __init__(self):
+        self._next = 0
+
+    def reserve_block(self, size: int) -> int:
+        """First id of a fresh block of `size` consecutive ids."""
+        base = self._next + 1
+        self._next += size
+        return base
+
+    def get_next_tx_id(self) -> str:
+        return str(self.reserve_block(1))
 
     def restart_counter(self):
-        self._next_transaction_id = 0
+        self._next = 0
 
     def set_counter(self, tx_id):
-        self._next_transaction_id = tx_id
+        self._next = tx_id
 
 
 tx_id_manager = TxIdManager()
@@ -53,9 +67,22 @@ class TransactionStartSignal(Exception):
         self.global_state = global_state
 
 
+#: tx fields minted as fresh symbols when the caller leaves them None:
+#: attribute name -> symbol-name prefix (suffixed with the tx id)
+_SYMBOLIC_FIELDS = (
+    ("gas_price", "gasprice"),
+    ("base_fee", "basefee"),
+    ("origin", "origin"),
+    ("call_value", "callvalue"),
+)
+
+
 class BaseTransaction:
-    """Common transaction data; symbolic defaults for unconstrained
-    fields."""
+    """Common transaction data. Subclasses declare the entry function
+    name and how the entry Environment is built; id/symbol minting, the
+    value transfer, and entry-state spawning live here once."""
+
+    entry_function = "fallback"
 
     def __init__(
         self,
@@ -76,26 +103,23 @@ class BaseTransaction:
         assert isinstance(world_state, WorldState)
         self.world_state = world_state
         self.id = identifier or tx_id_manager.get_next_tx_id()
-
-        self.gas_price = (
-            gas_price
-            if gas_price is not None
-            else symbol_factory.BitVecSym(f"gasprice{identifier}", 256)
-        )
-        self.base_fee = (
-            base_fee
-            if base_fee is not None
-            else symbol_factory.BitVecSym(f"basefee{identifier}", 256)
-        )
         self.gas_limit = gas_limit
-        self.origin = (
-            origin
-            if origin is not None
-            else symbol_factory.BitVecSym(f"origin{identifier}", 256)
-        )
         self.code = code
         self.caller = caller
         self.callee_account = callee_account
+        self.static = static
+        self.return_data: Optional[ReturnData] = None
+
+        given = dict(gas_price=gas_price, base_fee=base_fee,
+                     origin=origin, call_value=call_value)
+        for field, prefix in _SYMBOLIC_FIELDS:
+            value = given[field]
+            if value is None:
+                value = symbol_factory.BitVecSym(
+                    f"{prefix}{identifier}", 256
+                )
+            setattr(self, field, value)
+
         if call_data is None and init_call_data:
             self.call_data: BaseCalldata = SymbolicCalldata(self.id)
         else:
@@ -104,62 +128,51 @@ class BaseTransaction:
                 if isinstance(call_data, BaseCalldata)
                 else ConcreteCalldata(self.id, [])
             )
-        self.call_value = (
-            call_value
-            if call_value is not None
-            else symbol_factory.BitVecSym(f"callvalue{identifier}", 256)
-        )
-        self.static = static
-        self.return_data: Optional[ReturnData] = None
 
-    def initial_global_state_from_environment(self, environment,
-                                              active_function):
-        global_state = GlobalState(self.world_state, environment, None)
-        global_state.environment.active_function_name = active_function
+    # -- entry-state spawning ---------------------------------------------
 
-        sender = environment.sender
-        receiver = environment.active_account.address
-        value = (
-            environment.callvalue
-            if isinstance(environment.callvalue, BitVec)
-            else symbol_factory.BitVecVal(environment.callvalue, 256)
-        )
-        global_state.world_state.constraints.append(
-            UGE(global_state.world_state.balances[sender], value)
-        )
-        global_state.world_state.balances[receiver] += value
-        global_state.world_state.balances[sender] -= value
-        return global_state
-
-    def initial_global_state(self) -> GlobalState:
+    def _entry_environment(self) -> Environment:
         raise NotImplementedError
 
+    def initial_global_state(self) -> GlobalState:
+        """Entry GlobalState: build this kind's environment, apply the
+        value transfer to the world state (with the solvency
+        constraint), spawn."""
+        environment = self._entry_environment()
+        global_state = GlobalState(self.world_state, environment, None)
+        global_state.environment.active_function_name = \
+            self.entry_function
+
+        value = environment.callvalue
+        if not isinstance(value, BitVec):
+            value = symbol_factory.BitVecVal(value, 256)
+        world_state = global_state.world_state
+        sender = environment.sender
+        world_state.constraints.append(
+            UGE(world_state.balances[sender], value)
+        )
+        world_state.balances[environment.active_account.address] += value
+        world_state.balances[sender] -= value
+        return global_state
+
     def __str__(self) -> str:
-        if (
-            self.callee_account is None
-            or self.callee_account.address.symbolic is False
-        ):
-            return "{} {} from {} to {:#42x}".format(
-                self.__class__.__name__,
-                self.id,
-                self.caller,
-                self.callee_account.address.value
-                if self.callee_account
-                else -1,
-            )
+        callee = self.callee_account
+        if callee is not None and callee.address.symbolic is False:
+            to = "{:#42x}".format(callee.address.value)
+        elif callee is not None:
+            to = str(callee.address)
+        else:
+            to = "{:#42x}".format(-1)
         return "{} {} from {} to {}".format(
-            self.__class__.__name__,
-            self.id,
-            self.caller,
-            str(self.callee_account.address),
+            self.__class__.__name__, self.id, self.caller, to
         )
 
 
 class MessageCallTransaction(BaseTransaction):
     """A message call into an existing account."""
 
-    def initial_global_state(self) -> GlobalState:
-        environment = Environment(
+    def _entry_environment(self) -> Environment:
+        return Environment(
             self.callee_account,
             self.caller,
             self.call_data,
@@ -169,9 +182,6 @@ class MessageCallTransaction(BaseTransaction):
             self.base_fee,
             code=self.code or self.callee_account.code,
             static=self.static,
-        )
-        return super().initial_global_state_from_environment(
-            environment, active_function="fallback"
         )
 
     def end(self, global_state: GlobalState, return_data=None,
@@ -183,6 +193,8 @@ class MessageCallTransaction(BaseTransaction):
 class ContractCreationTransaction(BaseTransaction):
     """Contract creation; snapshots the pre-state and assigns returned
     runtime code to the new account at end()."""
+
+    entry_function = "constructor"
 
     def __init__(
         self,
@@ -229,8 +241,8 @@ class ContractCreationTransaction(BaseTransaction):
             base_fee=base_fee,
         )
 
-    def initial_global_state(self) -> GlobalState:
-        environment = Environment(
+    def _entry_environment(self) -> Environment:
+        return Environment(
             active_account=self.callee_account,
             sender=self.caller,
             calldata=self.call_data,
@@ -239,9 +251,6 @@ class ContractCreationTransaction(BaseTransaction):
             origin=self.origin,
             basefee=self.base_fee,
             code=self.code,
-        )
-        return super().initial_global_state_from_environment(
-            environment, active_function="constructor"
         )
 
     def end(self, global_state: GlobalState, return_data=None,

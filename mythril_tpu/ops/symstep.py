@@ -1089,24 +1089,48 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
 def sym_run(code: CompiledCode, st: SymLaneState, max_steps: int,
             exec_table: jnp.ndarray = None,
-            taint_table: jnp.ndarray = None) -> SymLaneState:
+            taint_table: jnp.ndarray = None,
+            visited: jnp.ndarray = None):
     """Run up to max_steps (one sync window). max_steps must not exceed
-    the deferred-log capacity (one record per lane per step)."""
+    the deferred-log capacity (one record per lane per step).
+
+    `visited` is an optional per-byte-address coverage bitmap (device
+    resident, accumulated across windows): each step marks the pc of
+    every RUNNING lane before it executes — the device twin of the
+    interpreter's execute_state coverage hook.  Returns (state,
+    visited); visited is None when not requested."""
     if exec_table is None:
         exec_table = SYM_EXECUTABLE
     if taint_table is None:
         taint_table = np.zeros(256, bool)
 
-    def cond(carry):
-        s, i = carry
+    if visited is None:
+
+        def cond(carry):
+            s, i = carry
+            return (i < max_steps) & jnp.any(s.status == Status.RUNNING)
+
+        def body(carry):
+            s, i = carry
+            return sym_step(code, s, exec_table, taint_table), i + 1
+
+        final, _ = lax.while_loop(cond, body, (st, jnp.int32(0)))
+        return final, None
+
+    def cond_v(carry):
+        s, i, _ = carry
         return (i < max_steps) & jnp.any(s.status == Status.RUNNING)
 
-    def body(carry):
-        s, i = carry
-        return sym_step(code, s, exec_table, taint_table), i + 1
+    def body_v(carry):
+        s, i, vis = carry
+        mark = jnp.where(s.status == Status.RUNNING, s.pc,
+                         vis.shape[0])
+        vis = vis.at[mark].set(True, mode="drop")
+        return sym_step(code, s, exec_table, taint_table), i + 1, vis
 
-    final, _ = lax.while_loop(cond, body, (st, jnp.int32(0)))
-    return final
+    final, _, visited = lax.while_loop(
+        cond_v, body_v, (st, jnp.int32(0), visited))
+    return final, visited
 
 
 sym_run_jit = jax.jit(sym_run, static_argnums=(2,), donate_argnums=(1,))
