@@ -218,6 +218,8 @@ def bench_symbolic(n_lanes=4096, trials=None):
         lane_s, lane_paths = _explore(code, n_lanes)
         lane_walls.append(lane_s)
         assert lane_paths == host_paths, (lane_paths, host_paths)
+    from mythril_tpu.smt import repair
+
     stats = lane_engine.RUN_STATS_TOTAL
     lane_med = statistics.median(lane_walls)
     host_med = statistics.median(host_walls)
@@ -233,6 +235,8 @@ def bench_symbolic(n_lanes=4096, trials=None):
             "device_forks": stats.get("forks"),
             "device_steps": stats.get("device_steps"),
             "windows": stats.get("windows"),
+            "sha3_resumed_in_place": stats.get("resumed"),
+            "model_repairs": dict(repair.STATS),
         },
     }
 

@@ -145,7 +145,9 @@ class SymExecWrapper:
         # will actually run — and kept when a selected module pins JUMPI
         # to the host (no lane adapter), which idles the sweep
         # (svm._lane_engine_sweep) and pruning is all the help we get
-        lane_engine_active = bool(args.tpu_lanes) \
+        from ..support.devices import effective_tpu_lanes
+
+        lane_engine_active = bool(effective_tpu_lanes()) \
             and not args.use_issue_annotations
         if lane_engine_active and run_analysis_modules:
             # mirror of svm._lane_engine_sweep's hook gate: a module

@@ -258,7 +258,9 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
     # TPU lane-engine knobs (new in this build)
     options.add_argument("--tpu-lanes", type=int,
                         default=global_args.tpu_lanes,
-                        help="Batched lane-engine width (0 = host-only "
+                        help="Batched lane-engine width (-1 = auto: "
+                             "batched lanes on a local accelerator, "
+                             "host-only otherwise; 0 = host-only "
                              "reference engine; >0 = JAX/TPU batched "
                              "execution with N lanes)")
     options.add_argument("--no-tpu-prefilter", action="store_true",

@@ -360,6 +360,54 @@ def _retire_rows(st: SymLaneState, ridx, dstack: int, dmem: int,
     return st, rows
 
 
+@jax.jit
+def _resume_rows(st: SymLaneState, ridx):
+    """Slim pull for SHA3 resume candidates: top-2 stack entries,
+    gas counters, the RESUME_MEM memory prefix, and the overlay
+    records — everything the host needs to replay sha3_ semantics,
+    a fraction of a full retire row. No state mutation: declined
+    lanes keep their planes and retire through escalation."""
+    n = st.pc.shape[0]
+    rc = jnp.clip(ridx, 0, n - 1)
+    top = jnp.clip(st.sp[rc] - 1, 0, st.stack.shape[1] - 1)
+    sub = jnp.clip(st.sp[rc] - 2, 0, st.stack.shape[1] - 1)
+    i32 = jnp.concatenate([
+        st.msize[rc, None],
+        st.min_gas[rc, None].astype(jnp.int32),
+        st.max_gas[rc, None].astype(jnp.int32),
+        st.gas_limit[rc, None].astype(jnp.int32),
+        st.mlog_count[rc, None],
+        st.ssid[rc, top][:, None], st.ssid[rc, sub][:, None],
+        st.mlog_off[rc, :RESUME_MLOG], st.mlog_len[rc, :RESUME_MLOG],
+        st.mlog_sid[rc, :RESUME_MLOG],
+    ], axis=1)
+    u32 = jnp.concatenate(
+        [st.stack[rc, top], st.stack[rc, sub]], axis=1)
+    u8 = jnp.concatenate([
+        st.memory[rc, :RESUME_MEM], st.mkind[rc, :RESUME_MEM],
+    ], axis=1)
+    return i32, u32, u8
+
+
+def _unpack_resume(packed) -> dict:
+    """Host-side inverse of _resume_rows' packing."""
+    i32, u32, u8 = [np.asarray(x) for x in packed]
+    out = {}
+    for col, name in enumerate(("msize", "min_gas", "max_gas",
+                                "gas_limit", "mlog_count",
+                                "sid_top", "sid_sub")):
+        out[name] = i32[:, col]
+    off = 7
+    for name in ("mlog_off", "mlog_len", "mlog_sid"):
+        out[name] = i32[:, off:off + RESUME_MLOG]
+        off += RESUME_MLOG
+    out["top"] = u32[:, :bv256.NLIMBS]
+    out["sub"] = u32[:, bv256.NLIMBS:]
+    out["memory"] = u8[:, :RESUME_MEM]
+    out["mkind"] = u8[:, RESUME_MEM:]
+    return out
+
+
 def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
     """Host-side inverse of _retire_rows' packing."""
     i32, u32, u8 = [np.asarray(x) for x in packed]
@@ -390,10 +438,11 @@ def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
 
 
 def _counts_core(st: SymLaneState):
-    """Per-lane counters + scalars."""
+    """Per-lane counters + scalars (pc rides along so the host can
+    classify parked lanes for in-place resume without a row pull)."""
     misc = jnp.stack(
         [st.dlog_count, st.status, st.steps,
-         st.sp, st.scount, st.mlog_count, st.msize], axis=1)
+         st.sp, st.scount, st.mlog_count, st.msize, st.pc], axis=1)
     scal = jnp.stack([st.flog_count, st.free_count])
     return misc, scal
 
@@ -629,6 +678,18 @@ SEED_CD = 160
 #: a pathological >PROV_BUCKET window compiles the dense-sized variant
 PROV_BUCKET = 4096
 
+#: in-place resume envelope: a lane parked at SHA3 whose state fits
+#: these bounds is HELD on device — the host pulls a slim row (top-2
+#: stack entries + the memory prefix + overlay records), builds the
+#: keccak term itself, and uploads a ~60-byte patch with the next
+#: window instead of paying a full retire + GlobalState materialize +
+#: interpreter step + full re-seed round trip
+RESUME_MEM = SEED_MEM
+RESUME_MLOG = 8
+#: the SHA3 opcode byte (the only resumable op today; the mechanism
+#: generalizes to any pop-k/push-term instruction the host can model)
+_SHA3_BYTE = 0x20
+
 
 def _unpack_i32_sections(buf, sections):
     """Split a flat i32 buffer into named (shape, dtype) sections
@@ -666,6 +727,12 @@ def _seed_sections(n, k, n_env, sd, pv):
         ("kill", (n,), jnp.int32),
         ("stack_v", (k, sd * bv256.NLIMBS), jnp.uint32),
         ("stack_s", (k, sd), jnp.int32),
+        # in-place SHA3 resumes (same k bucket as seeds): lane index
+        # (padding n), then [pc, sp, msize, min_gas, max_gas, sid] and
+        # the concrete-result limbs
+        ("r_idx", (k,), jnp.int32),
+        ("r_i32", (k, 6), jnp.int32),
+        ("r_limbs", (k, bv256.NLIMBS), jnp.uint32),
     ]
 
 
@@ -673,7 +740,7 @@ def _seed_sections(n, k, n_env, sd, pv):
                    static_argnums=tuple(range(6, 10)))
 def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
                  taint_table, window: int, k: int, budget: int,
-                 pv: int, visited):
+                 pv: int, visited, resume_on):
     """The whole per-window device work in ONE dispatch with TWO packed
     host->device buffers — on a tunneled backend every dispatch is a
     full round trip and every input array is a separately-latencied
@@ -716,6 +783,23 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     st = _remap_reset_core(st, a["prov"])
     st = st._replace(status=st.status.at[a["kill"]].set(
         DEAD, mode="drop"))
+    # apply in-place SHA3 resumes: held lanes get the host-built hash
+    # pushed (sid or concrete limbs), gas/msize accounted, and run on
+    r = a["r_idx"]
+    ri = a["r_i32"]
+    slot = jnp.clip(ri[:, 1] - 1, 0, n_depth - 1)
+    st = st._replace(
+        pc=st.pc.at[r].set(ri[:, 0], mode="drop"),
+        sp=st.sp.at[r].set(ri[:, 1], mode="drop"),
+        msize=st.msize.at[r].set(ri[:, 2], mode="drop"),
+        min_gas=st.min_gas.at[r].set(
+            ri[:, 3].astype(st.min_gas.dtype), mode="drop"),
+        max_gas=st.max_gas.at[r].set(
+            ri[:, 4].astype(st.max_gas.dtype), mode="drop"),
+        ssid=st.ssid.at[r, slot].set(ri[:, 5], mode="drop"),
+        stack=st.stack.at[r, slot].set(a["r_limbs"], mode="drop"),
+        status=st.status.at[r].set(Status.RUNNING, mode="drop"),
+    )
     st = _prologue_core(st, a["idx"], a["i32p"], a["u32p"], u8p,
                         stack_v, stack_s, mem_v, mem_k, a["fs"],
                         a["fcount"])
@@ -735,7 +819,16 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
     fits = (
         (st.sp <= dstack) & (st.msize <= dmem)
         & (st.mlog_count <= dmlog) & (st.scount <= dslot))
-    elig = parked & fits
+    # SHA3-parked lanes inside the resume envelope stay on device for
+    # in-place resume (the host pulls a slim row and patches them; any
+    # it declines still retire through this window's escalation).
+    # resume_on is a traced scalar so toggling it forks no jit variant.
+    op_at_pc = cc.opcode[jnp.clip(st.pc, 0, cc.packed.shape[0] - 1)]
+    hold = (
+        (resume_on != 0) & (st.status == Status.NEEDS_HOST)
+        & (op_at_pc == _SHA3_BYTE) & (st.sp >= 2)
+        & (st.msize <= RESUME_MEM) & (st.mlog_count <= RESUME_MLOG))
+    elig = parked & fits & ~hold
     order = jnp.cumsum(elig.astype(jnp.int32)) - 1
     take = elig & (order < rcap)
     ridx = jnp.full((rcap,), n, jnp.int32)
@@ -909,7 +1002,7 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
     visited = jnp.zeros(cc.packed.shape[0], bool)
     st, visited, out = _window_exec(
         st, cc, i32buf, u8buf, eng.exec_table, eng.taint_table,
-        window, k, step_budget, pv, visited)
+        window, k, step_budget, pv, visited, eng._resume_flag)
     jax.block_until_ready(out)
     if not big:
         # escalation variants this engine config can hit mid-explore
@@ -1067,7 +1160,13 @@ class LaneEngine:
         self.stats = {
             "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
             "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
+            "resumed": 0,
         }
+        # in-place SHA3 resume: off whenever a detector hooks SHA3
+        # (the hook must fire host-side; no adapter lifts SHA3 today)
+        self.resume_on = "SHA3" not in set(blocked_ops or ())
+        self._resume_flag = jnp.asarray(
+            1 if self.resume_on else 0, jnp.int32)
         self.last_run_stats: Optional[dict] = None
 
     # -- seeding ------------------------------------------------------------
@@ -1234,7 +1333,8 @@ class LaneEngine:
         )
 
     def _pack_window(self, entries, ctxs: List[Optional[LaneCtx]],
-                     free, kill, calldata_cap: int, big: bool = False):
+                     free, kill, calldata_cap: int, big: bool = False,
+                     resumes=()):
         """Pack EVERYTHING the next window dispatch needs from the host
         into two flat buffers (one i32, one u8): seed rows, free-slot
         stack, the previous drain's provisional-sid resolutions, and
@@ -1263,7 +1363,7 @@ class LaneEngine:
         # drains seed floods in one window. explore() only requests
         # `big` once that variant is warm.
         k = n if big else min(16, n)
-        assert len(lanes) <= k
+        assert len(lanes) <= k and len(resumes) <= k
 
         idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
         idx[: len(lanes)] = lanes
@@ -1303,12 +1403,23 @@ class LaneEngine:
             prov_pairs[j, 1] = oid
         kl = np.full(n, n, np.int32)
         kl[: len(kill)] = kill
+        r_idx = np.full(k, n, np.int32)
+        r_i32 = np.zeros((k, 6), np.int32)
+        r_limbs = np.zeros((k, bv256.NLIMBS), np.uint32)
+        for i, (lane, pc, sp, msize, ming, maxg, sid, limbs) \
+                in enumerate(resumes):
+            r_idx[i] = lane
+            r_i32[i] = (pc, sp, msize, ming, maxg, sid)
+            if limbs is not None:
+                r_limbs[i] = limbs
 
         parts = [idx, i32p.reshape(-1), u32p.reshape(-1).view(np.int32),
                  fs, np.array([len(free)], np.int32),
                  prov_pairs.reshape(-1), kl,
                  stack_v.reshape(-1).view(np.int32),
-                 stack_s.reshape(-1)]
+                 stack_s.reshape(-1),
+                 r_idx, r_i32.reshape(-1),
+                 r_limbs.reshape(-1).view(np.int32)]
         i32buf = np.concatenate([np.ascontiguousarray(p, np.int32)
                                  for p in parts])
         u8buf = np.concatenate([u8p.reshape(-1), mem_v.reshape(-1),
@@ -1543,6 +1654,112 @@ class LaneEngine:
         idx = -sid - 1
         return self.objects[self._prov[(idx // d_recs, idx % d_recs)]]
 
+    def _try_resume(self, rows: dict, i: int, byte_pc: int, sp: int
+                    ) -> Optional[tuple]:
+        """Replay sha3_ semantics (laser/instructions.py:395-448) for a
+        held lane from its slim row; returns the device patch
+        (pc, sp, msize, min_gas, max_gas, sid, limbs) or None to
+        decline (symbolic length, out-of-gas, oversized hash — the
+        escalation path then hands the lane to the interpreter, which
+        owns the constraint-adding and exception semantics)."""
+        from ..support.eth_constants import (
+            GAS_MEMORY, GAS_MEMORY_QUADRATIC_DENOMINATOR, ceil32,
+        )
+        from .function_managers import keccak_function_manager
+        from .instruction_data import calculate_sha3_gas
+        from .transaction import tx_id_manager
+
+        if int(rows["sid_sub"][i]):
+            return None  # symbolic length: interpreter concretizes
+        length = _limbs_int(rows["sub"][i])
+        if length > 4096:
+            return None  # oversized: not worth modeling off-row
+        min_gas = int(rows["min_gas"][i])
+        max_gas = int(rows["max_gas"][i])
+        sha3_min, sha3_max = calculate_sha3_gas(length)
+        min_gas += sha3_min
+        max_gas += sha3_max
+
+        msize = int(rows["msize"][i])
+        new_msize = msize
+        sid_top = int(rows["sid_top"][i])
+        index = None
+        if sid_top == 0:
+            index = _limbs_int(rows["top"][i])
+            if index + length > 1 << 20:
+                return None
+            if length > 0 and msize <= index + length:
+                # mem_extend: word-aligned growth + quadratic fee
+                # (state/machine_state.py:96-142)
+                new_msize = ceil32(index + length)
+                for size, sign in ((new_msize, 1), (msize, -1)):
+                    words = size // 32
+                    fee = words * GAS_MEMORY + words ** 2 \
+                        // GAS_MEMORY_QUADRATIC_DENOMINATOR
+                    min_gas += sign * fee
+                    max_gas += sign * fee
+                if new_msize > self.lane_kwargs.get(
+                        "memory_bytes", 4096):
+                    return None  # outgrows the device planes
+        if min_gas >= int(rows["gas_limit"][i]):
+            return None  # OOG: the interpreter owns the exception
+
+        if length == 0:
+            result = keccak_function_manager.get_empty_keccak_hash()
+        elif index is None:
+            # symbolic offset: hash a fresh per-site symbolic input
+            # (instructions.py:421-432)
+            result = keccak_function_manager.create_keccak(
+                symbol_factory.BitVecSym(
+                    f"sha3_input_{tx_id_manager.get_next_tx_id()}",
+                    length * 8,
+                ))
+        else:
+            mem = rows["memory"][i]
+            kind = rows["mkind"][i]
+            sym_cover: Dict[int, Tuple[object, int]] = {}
+            for r in range(int(rows["mlog_count"][i])):
+                off = int(rows["mlog_off"][i, r])
+                for j in range(int(rows["mlog_len"][i, r])):
+                    sym_cover[off + j] = (
+                        self._obj(int(rows["mlog_sid"][i, r])), j)
+            byte_list = []
+            for j in range(index, index + length):
+                k = int(kind[j]) if j < RESUME_MEM else 0
+                if k == symstep.KIND_SYM_WORD:
+                    obj, jj = sym_cover[j]
+                    if isinstance(obj, Bool):
+                        obj = If(obj, _bv_val(1), _bv_val(0))
+                    byte_list.append(simplify(
+                        Extract(255 - 8 * jj, 248 - 8 * jj, obj)))
+                elif k == symstep.KIND_CONC_WORD:
+                    byte_list.append(
+                        symbol_factory.BitVecVal(int(mem[j]), 8))
+                else:  # written int byte, or the default-zero region
+                    byte_list.append(int(mem[j]) if j < RESUME_MEM
+                                     else 0)
+            if all(isinstance(b, int) for b in byte_list):
+                data = symbol_factory.BitVecVal(
+                    int.from_bytes(bytes(byte_list), "big"),
+                    length * 8)
+            else:
+                from ..smt import Concat
+
+                parts = [
+                    b if isinstance(b, BitVec)
+                    else symbol_factory.BitVecVal(b, 8)
+                    for b in byte_list
+                ]
+                data = simplify(Concat(parts))
+            result = keccak_function_manager.create_keccak(data)
+
+        if result.value is not None and not result.annotations:
+            sid, limbs = 0, bv256.int_to_limbs(result.value)
+        else:
+            sid, limbs = self.objects.add(result), None
+        return (byte_pc + 1, sp - 1, new_msize, min_gas, max_gas,
+                sid, limbs)
+
     def materialize(self, st_host: dict, lane: int,
                     ctx: LaneCtx) -> GlobalState:
         """Rebuild a host GlobalState for a parked lane. `st_host` is a
@@ -1697,6 +1914,7 @@ class LaneEngine:
         n = self.n_lanes
 
         kill: List[int] = []
+        resumes: List[tuple] = []
         small = min(16, self.n_lanes)
         try:
             while True:
@@ -1705,7 +1923,8 @@ class LaneEngine:
                 # once that variant is compiled (warm_variant kicks a
                 # background compile and the small bucket carries on)
                 seed_cap = small
-                if len(queue) > small and warm_variant(
+                if (len(queue) > small or len(resumes) > small) \
+                        and warm_variant(
                     self.n_lanes, len(code_bytes), self.lane_kwargs,
                     self.window, self.step_budget,
                     seed_bucket=self.n_lanes,
@@ -1722,14 +1941,16 @@ class LaneEngine:
                     entries.append((free.pop(), gs))
                 i32buf, u8buf, k, pv = self._pack_window(
                     entries, ctxs, free, kill, calldata_cap,
-                    big=seed_cap > small)
+                    big=seed_cap > small, resumes=resumes)
+                resumes = []
                 n_free_written = len(free)
                 _tw = time.perf_counter() if PROF_ON else 0.0
                 with _prof("window_exec", sync=lambda: st.pc):
                     st, visited, out = _window_exec(
                         st, cc, i32buf, u8buf, self.exec_table,
                         self.taint_table, self.window, k,
-                        self.step_budget, pv, visited)
+                        self.step_budget, pv, visited,
+                        self._resume_flag)
                 # the kill landed at the dispatch's reset phase: only now
                 # may the slots be recycled (they enter the free stack the
                 # device sees at the NEXT dispatch)
@@ -1749,7 +1970,7 @@ class LaneEngine:
                     "dlog_count": misc[:, 0], "status": misc[:, 1],
                     "steps": misc[:, 2], "sp": misc[:, 3],
                     "scount": misc[:, 4], "mlog_count": misc[:, 5],
-                    "msize": misc[:, 6],
+                    "msize": misc[:, 6], "pc": misc[:, 7],
                     "flog_count": int(scal[0]),
                     "free_count": int(scal[1]),
                     "ucount": int(scal[2]),
@@ -1820,6 +2041,52 @@ class LaneEngine:
                     & (steps >= self.step_budget)
                 rest = np.nonzero(
                     (status == Status.NEEDS_HOST) | runaway)[0].tolist()
+                # 2a. in-place resume: SHA3-parked lanes in the envelope
+                # get a slim-row pull + host keccak term + device patch
+                # with the next window, instead of retire/materialize/
+                # interpreter-step/re-seed (~60 B vs ~10 KB round trip)
+                if self.resume_on and rest:
+                    pcs = counts_h["pc"]
+                    cand = [
+                        lane for lane in rest
+                        if status[lane] == Status.NEEDS_HOST
+                        and lane not in dead_set
+                        and int(pcs[lane]) < len(code_bytes)
+                        and code_bytes[int(pcs[lane])] == _SHA3_BYTE
+                        and int(counts_h["sp"][lane]) >= 2
+                        and int(counts_h["msize"][lane]) <= RESUME_MEM
+                        and int(counts_h["mlog_count"][lane])
+                        <= RESUME_MLOG
+                    ]
+                    cap_r = small
+                    if len(cand) > small and warm_variant(
+                        self.n_lanes, len(code_bytes),
+                        self.lane_kwargs, self.window,
+                        self.step_budget, seed_bucket=self.n_lanes,
+                    ):
+                        cap_r = self.n_lanes
+                    cand = cand[:cap_r]
+                    if cand:
+                        rr = _geo_bucket(len(cand), self.n_lanes,
+                                         min(16, self.n_lanes))
+                        ridx_r = np.full(rr, n, np.int32)
+                        ridx_r[: len(cand)] = cand
+                        with _prof("resume_pull"):
+                            rrows = _unpack_resume(jax.device_get(
+                                _resume_rows(st, jnp.asarray(ridx_r))))
+                        with _prof("resume_host"):
+                            for row_i, lane in enumerate(cand):
+                                patch = self._try_resume(
+                                    rrows, row_i,
+                                    int(pcs[lane]),
+                                    int(counts_h["sp"][lane]))
+                                if patch is not None:
+                                    resumes.append((lane,) + patch)
+                                    status[lane] = Status.RUNNING
+                                    self.stats["resumed"] += 1
+                        if resumes:
+                            kept = {r[0] for r in resumes}
+                            rest = [l for l in rest if l not in kept]
                 if rest:
                     c = counts_h
                     rsel = np.asarray(rest, np.int32)

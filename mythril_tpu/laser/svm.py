@@ -360,11 +360,14 @@ class LaserEVM:
             return False
 
         # count first, drain only on commitment: a drain-and-put-back
-        # would reorder the work list under the strategy
-        if sum(1 for gs in self.work_list if _device_ok(gs)) \
-                < min_batch:
+        # would reorder the work list under the strategy. Verdicts are
+        # memoized so the drain pass doesn't re-pay lane_seedable's
+        # per-state scans.
+        verdict = {id(gs): _device_ok(gs) for gs in self.work_list}
+        if sum(verdict.values()) < min_batch:
             return  # device round trips don't pay for a trickle
-        eligible = self.strategy.drain_eligible(_device_ok)
+        eligible = self.strategy.drain_eligible(
+            lambda gs: verdict[id(gs)])
         groups: Dict[bytes, List[GlobalState]] = {}
         for gs in eligible:
             groups.setdefault(code_of[id(gs)], []).append(gs)
@@ -424,7 +427,9 @@ class LaserEVM:
         final_states: List[GlobalState] = []
         for hook in self._start_exec_hooks:
             hook()
-        if args.tpu_lanes and not create and not track_gas:
+        from ..support.devices import effective_tpu_lanes
+
+        if effective_tpu_lanes() and not create and not track_gas:
             self._lane_engine_sweep()
 
         iter_since_sweep = 0

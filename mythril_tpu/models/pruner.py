@@ -60,8 +60,10 @@ def _device_succeeded() -> None:
 def prefilter_world_states(open_states: List) -> List:
     """Drop world states with an interval-infeasible constraint. Sound:
     only provably-unsat states are removed."""
+    from ..support.devices import effective_tpu_lanes
+
     if (
-        args.tpu_lanes
+        effective_tpu_lanes()
         and len(open_states) >= DEVICE_BATCH_THRESHOLD
         and _device_should_try()
     ):
@@ -97,9 +99,11 @@ def _screen_interval(items: List, get_constraints) -> List:
     """Shared interval screen: device-batched when large enough (with
     the failure backoff), host transfer functions otherwise. Sound —
     only provably-unsat items are dropped."""
+    from ..support.devices import effective_tpu_lanes
+
     out = None
     if (
-        args.tpu_lanes
+        effective_tpu_lanes()
         and len(items) >= DEVICE_BATCH_THRESHOLD
         and _device_should_try()
     ):

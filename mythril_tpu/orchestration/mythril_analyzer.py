@@ -73,6 +73,9 @@ class MythrilAnalyzer:
             cmd_args, "transaction_sequences", None
         )
         args.tpu_lanes = getattr(cmd_args, "tpu_lanes", args.tpu_lanes)
+        from ..support.devices import effective_tpu_lanes
+
+        effective_tpu_lanes()  # resolve the auto sentinel for this run
         if args.pruning_factor is None:
             args.pruning_factor = 1 if self.execution_timeout > 600 else 0
         # per-run context (SURVEY §5): this analyzer's keccak axioms,

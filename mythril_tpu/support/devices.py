@@ -24,6 +24,52 @@ def tunneled_backend() -> bool:
         return False
 
 
+def default_tpu_lanes() -> int:
+    """Lane width the `auto` tpu_lanes setting resolves to: batched
+    lanes by default on a LOCAL accelerator; host-only when there is no
+    accelerator or the chip sits behind a tunneled link (per-window
+    round trips dominate small analyses there — BASELINE.md measures
+    the corpus transport-bound at ~0.1 s/window; on a local chip the
+    same windows cost milliseconds)."""
+    import importlib.util
+    import sys
+
+    # never pay the jax import + backend bring-up just to resolve the
+    # sentinel to 0: on accelerator-less machines (no device plugin on
+    # the path and jax not already initialized) host-only is certain
+    if "jax" not in sys.modules:
+        try:
+            if not any(
+                importlib.util.find_spec(mod) is not None
+                for mod in ("libtpu", "jax_plugins")
+            ):
+                return 0
+        except Exception:
+            return 0
+    try:
+        import jax
+
+        device = jax.devices()[0]
+    except Exception:
+        return 0
+    if device.platform == "cpu" or tunneled_backend():
+        return 0
+    return 64
+
+
+def effective_tpu_lanes() -> int:
+    """args.tpu_lanes with the auto sentinel (<0) resolved — and cached
+    back onto the run context so every later reader sees the same
+    resolution."""
+    from .support_args import args
+
+    lanes = args.tpu_lanes
+    if lanes is None or lanes < 0:
+        lanes = default_tpu_lanes()
+        args.tpu_lanes = lanes
+    return lanes
+
+
 def enable_compile_cache() -> None:
     """Persistent XLA compilation cache: the lane-engine kernels take
     seconds to compile; caching them across processes makes CLI runs

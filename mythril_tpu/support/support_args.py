@@ -28,7 +28,10 @@ class Args(object, metaclass=Singleton):
         self.epic = False
         self.pruning_factor: Optional[float] = None
         # TPU lane-engine knobs (new in this build)
-        self.tpu_lanes = 0  # 0 = host-only engine; >0 = batched lane engine
+        # -1 = auto (batched lanes on a local accelerator, host-only
+        # otherwise — support/devices.default_tpu_lanes); 0 = host-only
+        # engine; >0 = batched lane engine with that width
+        self.tpu_lanes = -1
         self.tpu_prefilter = True
 
 
