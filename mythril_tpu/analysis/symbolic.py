@@ -213,7 +213,43 @@ class SymExecWrapper:
                 ),
             )
 
-        if contract.creation_code and create_timeout != 0:
+        # transaction-boundary checkpointing (support/checkpoint.py):
+        # install the per-round sink, and divert to resume_exec when a
+        # loadable snapshot exists
+        resumed = False
+        if args.checkpoint_file:
+            from hashlib import sha256
+
+            from ..support.checkpoint import (
+                load_checkpoint, save_checkpoint,
+            )
+
+            path = args.checkpoint_file
+            # bind snapshots to the analyzed code: multi-contract runs
+            # sharing one checkpoint file must not resume each other
+            code_id = sha256(
+                (contract.creation_code or contract.code or "")
+                .encode()).hexdigest()
+
+            def _sink(next_round, open_states, addr):
+                save_checkpoint(
+                    path, next_round, open_states,
+                    addr.value if isinstance(addr, BitVec) else addr,
+                    code_id)
+
+            self.laser.checkpoint_sink = _sink
+            payload = load_checkpoint(path, code_id)
+            if payload is not None:
+                self.laser.resume_exec(
+                    payload["open_states"],
+                    payload["target_address"],
+                    payload["round"],
+                )
+                resumed = True
+
+        if resumed:
+            pass  # analysis continues on the restored states
+        elif contract.creation_code and create_timeout != 0:
             self.laser.sym_exec(
                 creation_code=contract.creation_code,
                 contract_name=contract.name,

@@ -266,6 +266,10 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
     options.add_argument("--no-tpu-prefilter", action="store_true",
                         help="Disable the on-device interval/bit "
                              "constraint pre-filter")
+    options.add_argument("--checkpoint", metavar="FILE", default=None,
+                        help="Checkpoint the analysis after each "
+                             "symbolic transaction round; if FILE "
+                             "already holds a snapshot, resume from it")
 
 
 def create_analyzer_parser(parser: argparse.ArgumentParser) -> None:

@@ -123,10 +123,15 @@ class Account:
         )
 
         self._balances = balances
-        self.balance = lambda: self._balances[self.address]
 
         self.contract_name = contract_name or "Unknown"
         self.deleted = False
+
+    def balance(self):
+        """This account's entry in the world-state balance array (a
+        method, not the reference's instance lambda — closures cannot
+        be pickled by the checkpoint layer)."""
+        return self._balances[self.address]
 
     def __str__(self) -> str:
         return str(self.as_dict)

@@ -82,6 +82,10 @@ class LaserEVM:
 
         self.time: Optional[datetime] = None
         self.executed_transactions: bool = False
+        # checkpoint/resume seam (support/checkpoint.py): first unrun
+        # round, and the per-round snapshot callback
+        self.start_round: int = 0
+        self.checkpoint_sink: Optional[Callable] = None
 
         self.pre_hooks: Dict[str, List[Callable]] = defaultdict(list)
         self.post_hooks: Dict[str, List[Callable]] = defaultdict(list)
@@ -190,6 +194,24 @@ class LaserEVM:
         for hook in self._stop_sym_exec_hooks:
             hook()
 
+    def resume_exec(self, open_states, address, start_round: int
+                    ) -> None:
+        """Continue a checkpointed analysis: restored open states, the
+        original target address, and the first UNRUN transaction round
+        (support/checkpoint.py owns the snapshot format)."""
+        log.info("Resuming symbolic execution at round %d", start_round)
+        for hook in self._start_sym_exec_hooks:
+            hook()
+        time_handler.start_execution(self.execution_timeout)
+        self.time = datetime.now()
+        self.open_states = list(open_states)
+        self.start_round = start_round
+        if isinstance(address, int):
+            address = symbol_factory.BitVecVal(address, 256)
+        self.execute_transactions(address)
+        for hook in self._stop_sym_exec_hooks:
+            hook()
+
     def execute_transactions(self, address) -> None:
         for hook in self._start_exec_trans_hooks:
             hook()
@@ -200,9 +222,12 @@ class LaserEVM:
 
     def _execute_transactions(self, address):
         """Execute transaction_count message calls against `address` from
-        all open states, pruning unreachable open states between rounds."""
+        all open states, pruning unreachable open states between rounds.
+        `start_round` skips completed rounds (checkpoint resume); the
+        `checkpoint_sink` callback fires after each completed round with
+        (next round index, open states, concrete target address)."""
         self.time = datetime.now()
-        for i in range(self.transaction_count):
+        for i in range(self.start_round, self.transaction_count):
             if len(self.open_states) == 0:
                 break
             old_states_count = len(self.open_states)
@@ -239,6 +264,9 @@ class LaserEVM:
             execute_message_call(self, address, func_hashes=func_hashes)
             for hook in self._stop_sym_trans_hooks:
                 hook()
+            if self.checkpoint_sink is not None:
+                self.checkpoint_sink(i + 1, self.open_states, address)
+        self.start_round = 0  # a later sym_exec must not skip rounds
         self.executed_transactions = True
 
     def _prune_unreachable_states(self, open_states):

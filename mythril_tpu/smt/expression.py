@@ -49,6 +49,22 @@ class Expression(Generic[G]):
     def __hash__(self) -> int:
         return self.raw.tid
 
+    def __reduce__(self):
+        # checkpoint pickling: rebuild with `raw` set IMMEDIATELY (the
+        # object may be a dict key inside a reference cycle, so it must
+        # hash before its BUILD state arrives); everything else — the
+        # annotation set, subclass fields — restores through the state
+        # dict afterwards
+        state = dict(self.__dict__)
+        state.pop("raw", None)
+        return (_rebuild_expr, (self.__class__, self.raw), state)
+
+
+def _rebuild_expr(cls, raw):
+    obj = cls.__new__(cls)
+    obj.raw = raw
+    return obj
+
 
 def simplify(expression: Expression) -> Expression:
     """Rebuild the term (constructors fold constants / apply local rules).

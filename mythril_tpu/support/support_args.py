@@ -33,6 +33,8 @@ class Args(object, metaclass=Singleton):
         # engine; >0 = batched lane engine with that width
         self.tpu_lanes = -1
         self.tpu_prefilter = True
+        # transaction-boundary checkpoint/resume (support/checkpoint.py)
+        self.checkpoint_file = None
 
 
 args = Args()

@@ -1,0 +1,121 @@
+"""Transaction-boundary checkpoint/resume (support/checkpoint.py): a
+run resumed from a round-1 snapshot must report the same issues as an
+uninterrupted run, without re-executing round 1."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.orchestration.mythril_analyzer import (
+    MythrilAnalyzer,
+    reset_analysis_state,
+)
+from mythril_tpu.orchestration.mythril_disassembler import (
+    MythrilDisassembler,
+)
+from mythril_tpu.support.analysis_args import make_cmd_args
+
+FIXTURE = Path("/root/reference/tests/testdata/inputs/metacoin.sol.o")
+
+pytestmark = pytest.mark.skipif(
+    not FIXTURE.exists(), reason="fixture corpus not present")
+
+
+def _analyze(tx_count, checkpoint=None):
+    reset_analysis_state()
+    disassembler = MythrilDisassembler(eth=None)
+    address, _ = disassembler.load_from_bytecode(
+        FIXTURE.read_text().strip(), bin_runtime=True)
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler,
+        cmd_args=make_cmd_args(execution_timeout=120,
+                               checkpoint=checkpoint),
+        strategy="bfs",
+        address=address,
+    )
+    report = analyzer.fire_lasers(modules=None,
+                                  transaction_count=tx_count)
+    return sorted(
+        (i["swc-id"], i["address"], i["title"])
+        for i in report.sorted_issues()
+    )
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    baseline = _analyze(2)
+
+    ckpt = str(tmp_path / "run.ckpt")
+    # phase 1: one round only, snapshot written at its end
+    first = _analyze(1, checkpoint=ckpt)
+    assert Path(ckpt).exists()
+
+    # phase 2: full tx count against the snapshot — resumes at round 1
+    from mythril_tpu.laser import svm as svm_mod
+
+    rounds = []
+    orig = svm_mod.execute_message_call
+
+    def counting(laser_evm, address, func_hashes=None):
+        rounds.append(len(laser_evm.open_states))
+        return orig(laser_evm, address, func_hashes=func_hashes)
+
+    svm_mod.execute_message_call = counting
+    try:
+        resumed = _analyze(2, checkpoint=ckpt)
+    finally:
+        svm_mod.execute_message_call = orig
+
+    # only ONE message-call round ran in the resumed analysis
+    assert len(rounds) == 1
+    assert resumed == baseline
+    # phase-1 issues survived into the resumed report
+    assert set(first) <= set(resumed)
+
+
+def test_corrupt_checkpoint_starts_fresh(tmp_path):
+    ckpt = tmp_path / "bad.ckpt"
+    ckpt.write_bytes(b"not a pickle")
+    issues = _analyze(1, checkpoint=str(ckpt))
+    baseline = _analyze(1)
+    assert issues == baseline
+
+
+def test_snapshot_is_code_bound(tmp_path):
+    """A snapshot saved for one contract must not be resumed by
+    another analysis sharing the same checkpoint file."""
+    from mythril_tpu.support.checkpoint import (
+        load_checkpoint, save_checkpoint,
+    )
+    from mythril_tpu.laser.state.world_state import WorldState
+
+    ckpt = str(tmp_path / "bound.ckpt")
+    save_checkpoint(ckpt, 1, [WorldState()], 0xABC, code_id="aaaa")
+    assert load_checkpoint(ckpt, code_id="bbbb") is None
+    restored = load_checkpoint(ckpt, code_id="aaaa")
+    assert restored is not None and restored["round"] == 1
+
+
+def test_deep_term_chains_serialize_iteratively(tmp_path):
+    """Constraint chains deeper than Python's recursion limit — the
+    loop-heavy analyses the feature exists for — must round-trip."""
+    from mythril_tpu.laser.state.world_state import WorldState
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.support.checkpoint import (
+        load_checkpoint, save_checkpoint,
+    )
+
+    ws = WorldState()
+    x = symbol_factory.BitVecSym("deep", 256)
+    chain = x
+    for i in range(30_000):
+        chain = chain + symbol_factory.BitVecSym(f"v{i % 7}", 256)
+    ws.constraints.append(chain == symbol_factory.BitVecVal(1, 256))
+
+    ckpt = str(tmp_path / "deep.ckpt")
+    save_checkpoint(ckpt, 1, [ws], 0xABC, code_id="deep")
+    restored = load_checkpoint(ckpt, code_id="deep")
+    assert restored is not None
+    [ws2] = restored["open_states"]
+    # identical term graph after re-interning
+    assert ws2.constraints[-1].raw is ws.constraints[-1].raw
