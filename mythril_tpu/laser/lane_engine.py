@@ -360,15 +360,13 @@ def _retire_rows(st: SymLaneState, ridx, dstack: int, dmem: int,
     return st, rows
 
 
-@jax.jit
-def _resume_rows(st: SymLaneState, ridx):
-    """Slim pull for SHA3 resume candidates: top-2 stack entries,
+def _resume_gather_core(st: SymLaneState, rc):
+    """Slim rows for in-place-resume candidates: top-2 stack entries,
     gas counters, the RESUME_MEM memory prefix, and the overlay
-    records — everything the host needs to replay sha3_ semantics,
-    a fraction of a full retire row. No state mutation: declined
+    records — everything the host needs to replay a pop-k/push-term
+    instruction's semantics, a fraction of a full retire row. Rides
+    the fused window dispatch (no separate round trip); declined
     lanes keep their planes and retire through escalation."""
-    n = st.pc.shape[0]
-    rc = jnp.clip(ridx, 0, n - 1)
     top = jnp.clip(st.sp[rc] - 1, 0, st.stack.shape[1] - 1)
     sub = jnp.clip(st.sp[rc] - 2, 0, st.stack.shape[1] - 1)
     i32 = jnp.concatenate([
@@ -390,7 +388,7 @@ def _resume_rows(st: SymLaneState, ridx):
 
 
 def _unpack_resume(packed) -> dict:
-    """Host-side inverse of _resume_rows' packing."""
+    """Host-side inverse of _resume_gather_core's packing."""
     i32, u32, u8 = [np.asarray(x) for x in packed]
     out = {}
     for col, name in enumerate(("msize", "min_gas", "max_gas",
@@ -662,6 +660,13 @@ def _remap_reset_core(st: SymLaneState, prov_pairs) -> SymLaneState:
 #: and retire through the escalation dispatch instead
 RCAP = 16
 RETIRE_FLOORS = (24, 512, 8, 8)
+#: in-place-resume hold budget per window (slim rows ride the fused
+#: output; ~1.2 KB each). Wider than RCAP: resumed lanes cost ~60 B of
+#: patch, while a force-retired lane pays a full retire row + host
+#: interpreter step + re-seed. The host still only patches what the
+#: next dispatch's seed-buffer resume section can carry (`small` until
+#: the full-width seed variant is warm).
+HOLD_CAP = 64
 
 #: device-seed column caps: a seed row ships only this much stack /
 #: concrete-memory / concrete-calldata content per lane. States past a
@@ -820,14 +825,24 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
         (st.sp <= dstack) & (st.msize <= dmem)
         & (st.mlog_count <= dmlog) & (st.scount <= dslot))
     # SHA3-parked lanes inside the resume envelope stay on device for
-    # in-place resume (the host pulls a slim row and patches them; any
-    # it declines still retire through this window's escalation).
+    # in-place resume: their slim rows ride THIS dispatch's output, the
+    # host builds the keccak term, and the patch rides the NEXT
+    # dispatch's seed buffer — no separate round trip in either
+    # direction. Any the host declines retire through escalation.
     # resume_on is a traced scalar so toggling it forks no jit variant.
+    hcap = min(HOLD_CAP, n)
     op_at_pc = cc.opcode[jnp.clip(st.pc, 0, cc.packed.shape[0] - 1)]
     hold = (
         (resume_on != 0) & (st.status == Status.NEEDS_HOST)
         & (op_at_pc == _SHA3_BYTE) & (st.sp >= 2)
         & (st.msize <= RESUME_MEM) & (st.mlog_count <= RESUME_MLOG))
+    horder = jnp.cumsum(hold.astype(jnp.int32)) - 1
+    hold = hold & (horder < hcap)  # excess candidates retire instead
+    hidx = jnp.full((hcap,), n, jnp.int32)
+    hidx = hidx.at[jnp.where(hold, horder, hcap)].set(
+        jnp.where(hold, jnp.arange(n), n).astype(jnp.int32),
+        mode="drop")
+    hrows = _resume_gather_core(st, jnp.clip(hidx, 0, n - 1))
     elig = parked & fits & ~hold
     order = jnp.cumsum(elig.astype(jnp.int32)) - 1
     take = elig & (order < rcap)
@@ -845,7 +860,8 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
                                                            n * d_recs))
     ftab = _fork_table(st, min(FB, n))
     scal = jnp.concatenate([scal, ucount[None]])
-    return st, visited, (misc, scal, utab, ftab, ridx) + rows
+    return st, visited, (misc, scal, utab, ftab, ridx) + rows \
+        + (hidx,) + hrows
 
 
 def _limbs_int(limbs) -> int:
@@ -1098,6 +1114,43 @@ _ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
 
 DEFAULT_WINDOW = 48
 DEFAULT_STEP_BUDGET = 8192
+
+
+#: per-code fork-scale observations: code -> peak width demand (lanes
+#: concurrently occupied + entries waiting for a slot) in any one
+#: explore. Feeds pick_width so a contract that demonstrably forks
+#: wide gets a wide engine on the next sweep, while small analyses
+#: stay on narrow (cheap) planes.
+PATH_HISTORY: Dict[bytes, int] = {}
+
+#: benchmark/test hook: pin the autotuned width so a timed run never
+#: cold-compiles a new variant mid-measurement (bench.py warms exactly
+#: this width before the clock starts). None = autotune normally.
+FORCE_WIDTH: Optional[int] = None
+
+
+def pick_width(cap: int, n_entries: int,
+               code: Optional[bytes] = None) -> int:
+    """Engine width for a sweep: the smallest power-of-two bucket with
+    generous fork headroom over the entry batch (and over the code's
+    observed fork scale), bounded by the configured lane cap. The cap
+    is CAPACITY, not a mandate — a 4096-wide plane set for a 30-path
+    contract pays init, transfers and per-window compute for lanes
+    that never run. Correctness never depends on the width: fork
+    pressure stalls parents until slots free, and the host
+    spill/refill path absorbs overflow
+    (tests/test_lane_spill_refill.py). Worklists that genuinely grow
+    pick a wider engine on the next sweep."""
+    if FORCE_WIDTH is not None:
+        return max(min(cap, FORCE_WIDTH), 1)
+    if cap <= 64:
+        return max(cap, 1)
+    demand = max(n_entries * 8,
+                 PATH_HISTORY.get(code, 0) if code else 0)
+    want = 64
+    while want < cap and want < demand:
+        want *= 2
+    return min(want, cap)
 
 
 class LaneEngine:
@@ -1916,6 +1969,7 @@ class LaneEngine:
         kill: List[int] = []
         resumes: List[tuple] = []
         small = min(16, self.n_lanes)
+        peak_demand = len(queue)
         try:
             while True:
                 # a seed backlog beyond the small bucket drains in ONE
@@ -1965,7 +2019,8 @@ class LaneEngine:
                 self.stats["windows"] += 1
                 with _prof("window_pull"):
                     (misc, scal, utab, ftab, ridx, r_i32, r_u32,
-                     r_u8) = [np.asarray(x) for x in jax.device_get(out)]
+                     r_u8, hidx, h_i32, h_u32, h_u8) = [
+                        np.asarray(x) for x in jax.device_get(out)]
                 counts_h = {
                     "dlog_count": misc[:, 0], "status": misc[:, 1],
                     "steps": misc[:, 2], "sp": misc[:, 3],
@@ -2041,52 +2096,41 @@ class LaneEngine:
                     & (steps >= self.step_budget)
                 rest = np.nonzero(
                     (status == Status.NEEDS_HOST) | runaway)[0].tolist()
-                # 2a. in-place resume: SHA3-parked lanes in the envelope
-                # get a slim-row pull + host keccak term + device patch
-                # with the next window, instead of retire/materialize/
-                # interpreter-step/re-seed (~60 B vs ~10 KB round trip)
-                if self.resume_on and rest:
-                    pcs = counts_h["pc"]
-                    cand = [
-                        lane for lane in rest
-                        if status[lane] == Status.NEEDS_HOST
-                        and lane not in dead_set
-                        and int(pcs[lane]) < len(code_bytes)
-                        and code_bytes[int(pcs[lane])] == _SHA3_BYTE
-                        and int(counts_h["sp"][lane]) >= 2
-                        and int(counts_h["msize"][lane]) <= RESUME_MEM
-                        and int(counts_h["mlog_count"][lane])
-                        <= RESUME_MLOG
-                    ]
+                # 2a. in-place resume: the device held SHA3-parked lanes
+                # in the envelope and shipped their slim rows with this
+                # window's output; build the keccak term host-side and
+                # patch them with the next dispatch — zero extra round
+                # trips. Declined lanes fall through to escalation.
+                held = [int(x) for x in hidx if x < n]
+                if held:
+                    # patches ride the next dispatch's seed buffer,
+                    # whose resume section holds `small` rows until the
+                    # full-width variant is warm; excess held lanes
+                    # fall through to escalation this window
                     cap_r = small
-                    if len(cand) > small and warm_variant(
+                    if len(held) > small and warm_variant(
                         self.n_lanes, len(code_bytes),
                         self.lane_kwargs, self.window,
                         self.step_budget, seed_bucket=self.n_lanes,
                     ):
                         cap_r = self.n_lanes
-                    cand = cand[:cap_r]
-                    if cand:
-                        rr = _geo_bucket(len(cand), self.n_lanes,
-                                         min(16, self.n_lanes))
-                        ridx_r = np.full(rr, n, np.int32)
-                        ridx_r[: len(cand)] = cand
-                        with _prof("resume_pull"):
-                            rrows = _unpack_resume(jax.device_get(
-                                _resume_rows(st, jnp.asarray(ridx_r))))
-                        with _prof("resume_host"):
-                            for row_i, lane in enumerate(cand):
-                                patch = self._try_resume(
-                                    rrows, row_i,
-                                    int(pcs[lane]),
-                                    int(counts_h["sp"][lane]))
-                                if patch is not None:
-                                    resumes.append((lane,) + patch)
-                                    status[lane] = Status.RUNNING
-                                    self.stats["resumed"] += 1
-                        if resumes:
-                            kept = {r[0] for r in resumes}
-                            rest = [l for l in rest if l not in kept]
+                    pcs = counts_h["pc"]
+                    rrows = _unpack_resume((h_i32, h_u32, h_u8))
+                    with _prof("resume_host"):
+                        for row_i, lane in enumerate(held):
+                            if row_i >= cap_r or lane in dead_set:
+                                continue
+                            patch = self._try_resume(
+                                rrows, row_i,
+                                int(pcs[lane]),
+                                int(counts_h["sp"][lane]))
+                            if patch is not None:
+                                resumes.append((lane,) + patch)
+                                status[lane] = Status.RUNNING
+                                self.stats["resumed"] += 1
+                    if resumes:
+                        kept = {r[0] for r in resumes}
+                        rest = [l for l in rest if l not in kept]
                 if rest:
                     c = counts_h
                     rsel = np.asarray(rest, np.int32)
@@ -2130,6 +2174,11 @@ class LaneEngine:
                     if lane not in retired:
                         kill.append(lane)
 
+                # width-demand sample: lanes concurrently occupied plus
+                # entries still queued for a slot (what a wide-enough
+                # engine would have run this window)
+                peak_demand = max(peak_demand,
+                                  n - len(free) + len(queue))
                 running = int(np.sum(status == Status.RUNNING))
                 if not running and not queue:
                     break
@@ -2147,6 +2196,8 @@ class LaneEngine:
         self._release_state(st)
         global LAST_RUN_STATS
         delta = {k: v - stats0.get(k, 0) for k, v in self.stats.items()}
+        if peak_demand > PATH_HISTORY.get(code_bytes, 0):
+            PATH_HISTORY[code_bytes] = peak_demand
         LAST_RUN_STATS = self.last_run_stats = delta
         for key, val in delta.items():
             RUN_STATS_TOTAL[key] = RUN_STATS_TOTAL.get(key, 0) + val

@@ -406,26 +406,43 @@ class LaserEVM:
         if cache is None:
             cache = self._lane_engines = {}
         from .lane_engine import (
-            DEFAULT_STEP_BUDGET, DEFAULT_WINDOW, warm_variant,
+            DEFAULT_STEP_BUDGET, DEFAULT_WINDOW, pick_width,
+            warm_variant,
         )
 
         for code, states in groups.items():
-            # route to the device only once its jit variant is compiled
-            # (on a tunneled backend the compile runs in a background
-            # thread while the host interpreter takes this batch)
-            if not warm_variant(args.tpu_lanes, len(code), {},
+            # width right-sizing: args.tpu_lanes is the CAP; the engine
+            # runs at the smallest bucket that fits this batch with
+            # fork headroom (narrow planes = cheap init, transfers and
+            # per-window compute on small analyses). When the desired
+            # width's jit variant is still compiling (background thread
+            # on a tunneled backend), fall back to the widest warm
+            # narrower bucket rather than to the host interpreter.
+            width = pick_width(args.tpu_lanes, len(states), code)
+            while width > 64 and not warm_variant(
+                    width, len(code), {},
+                    DEFAULT_WINDOW, DEFAULT_STEP_BUDGET):
+                width //= 2
+            if not warm_variant(width, len(code), {},
                                 DEFAULT_WINDOW, DEFAULT_STEP_BUDGET):
                 self.work_list.extend(states)
                 continue
-            key = (code, args.tpu_lanes, frozenset(blocked),
+            key = (code, width, frozenset(blocked),
                    tuple(id(a) for a in adapters))
             try:
                 engine = cache.get(key)
                 if engine is None:
-                    engine = LaneEngine(n_lanes=args.tpu_lanes,
+                    engine = LaneEngine(n_lanes=width,
                                         blocked_ops=blocked,
                                         adapters=adapters)
                     cache[key] = engine
+                    # keep at most two widths per code: drop the
+                    # narrowest surplus engine (its pooled device
+                    # planes stay in the bounded global pool)
+                    same = [k for k in cache
+                            if k[0] == code and k[2:] == key[2:]]
+                    if len(same) > 2:
+                        del cache[min(same, key=lambda k: k[1])]
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(
