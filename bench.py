@@ -214,7 +214,7 @@ def bench_symbolic(n_lanes=4096, trials=None):
     width = lane_engine.pick_width(n_lanes, 1, code)
     lane_engine.FORCE_WIDTH = width
     for bucket in (16, width):
-        lane_engine.warm_variant(width, len(code), {}, 48, 8192,
+        lane_engine.warm_variant(width, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
                                  seed_bucket=bucket, block=True)
     host_walls, lane_walls = [], []
     try:
@@ -330,7 +330,7 @@ def bench_configs():
         lane_engine.FORCE_WIDTH = width
         try:
             for bucket in (16, width):
-                lane_engine.warm_variant(width, 1024, {}, 48, 8192,
+                lane_engine.warm_variant(width, 1024, {}, lane_engine.DEFAULT_WINDOW, 8192,
                                          seed_bucket=bucket, block=True)
             host = _analyze_fixture(path, 120, txs, 0)
             lane = _analyze_fixture(path, 120, txs, lanes)

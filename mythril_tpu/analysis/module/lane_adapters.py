@@ -58,8 +58,9 @@ class LaneAdapter:
         """Called when the drain resolves a *new* deferred arithmetic
         record, before the result term is constructed."""
 
-    def on_sstore(self, value, site) -> List[object]:
-        """Promotions for a device-executed SSTORE sink record."""
+    def on_sstore(self, value, site, key=None) -> List[object]:
+        """Promotions for a device-executed SSTORE sink record. `key`
+        is the resolved storage key term (None on legacy call sites)."""
         return []
 
     def on_jumpi(self, cond, site) -> List[object]:
@@ -181,7 +182,7 @@ class IntegerAdapter(LaneAdapter):
             site.lazy_ostate(), operator, constraint
         ))
 
-    def on_sstore(self, value, site):
+    def on_sstore(self, value, site, key=None):
         from .modules.integer import OverUnderflowAnnotation
 
         return [a for a in value.annotations
@@ -205,15 +206,32 @@ class IntegerAdapter(LaneAdapter):
 
 
 class ArbitraryStorageAdapter(LaneAdapter):
-    """Device SSTOREs always have concrete keys (symbolic keys park);
-    the module's probe constraint `key == 324345425435` is unsatisfiable
-    for a concrete key unless the contract literally writes that slot —
-    a documented, astronomically-unlikely deviation (PARITY.md)."""
+    """Concrete-key device SSTOREs: the module's probe constraint
+    `key == 324345425435` is unsatisfiable unless the contract
+    literally writes that slot — a documented, astronomically-unlikely
+    deviation (PARITY.md). SYMBOLIC-key SSTOREs (the actual
+    arbitrary-write shape, executed on device by symbolic-storage
+    mode) run the real module against the reconstructed pre-SSTORE
+    site state; its PotentialIssues ride the promotion channel onto
+    every descendant state (interpreter parity: each path through the
+    SSTORE carries one) and discharge at transaction end as usual."""
 
     lifted_hooks = frozenset({"SSTORE"})
     _logged_deviation = False
 
-    def on_sstore(self, value, site):
+    def on_sstore(self, value, site, key=None):
+        if key is not None and getattr(key, "value", 0) is None:
+            from ..potential_issues import (
+                get_potential_issues_annotation,
+            )
+
+            # pre-SSTORE stack tail: [-2]=value, [-1]=write slot
+            site.stack_tail = (value, key)
+            state = site.build_state()
+            self.module.execute(state)
+            return list(
+                get_potential_issues_annotation(state).potential_issues
+            )
         if not ArbitraryStorageAdapter._logged_deviation:
             ArbitraryStorageAdapter._logged_deviation = True
             log.info(
@@ -221,7 +239,15 @@ class ArbitraryStorageAdapter(LaneAdapter):
                 "device-executed concrete-key SSTOREs with an "
                 "unsatisfiable constraint (host parity except a "
                 "contract writing slot 324345425435; see PARITY.md)")
-        return super().on_sstore(value, site)
+        return super().on_sstore(value, site, key)
+
+    def attach(self, gs, promotions, last_jump):
+        if not promotions:
+            return
+        from ..potential_issues import get_potential_issues_annotation
+
+        get_potential_issues_annotation(gs).potential_issues.extend(
+            promotions)
 
 
 class StateChangeAdapter(LaneAdapter):

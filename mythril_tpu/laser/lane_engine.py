@@ -86,7 +86,8 @@ class LaneCtx:
     drains, and per-adapter sink-taint promotions."""
 
     __slots__ = ("template", "conds", "addr2idx", "storage_seed_raw",
-                 "calldata", "gas0_min", "gas0_max", "promos")
+                 "calldata", "gas0_min", "gas0_max", "promos",
+                 "swrites")
 
     def __init__(self, template, addr2idx, storage_seed_raw, calldata,
                  gas0_min, gas0_max):
@@ -101,12 +102,17 @@ class LaneCtx:
         self.gas0_max = gas0_max
         # adapter-id -> [(step, annotation)] (lane_adapters promotions)
         self.promos: Dict[int, List[tuple]] = {}
+        # per-path storage-write mirror [(key BitVec, value BitVec)] in
+        # program order, built from the lane's SSTORE records —
+        # REC_SLOAD_RW resolution folds it over the seed storage
+        self.swrites: List[tuple] = []
 
     def clone(self) -> "LaneCtx":
         c = LaneCtx(self.template, self.addr2idx, self.storage_seed_raw,
                     self.calldata, self.gas0_min, self.gas0_max)
         c.conds = list(self.conds)
         c.promos = {k: list(v) for k, v in self.promos.items()}
+        c.swrites = list(self.swrites)
         return c
 
 
@@ -289,6 +295,9 @@ def _prologue_core(st: SymLaneState, idx, i32p, u32p, u8p, stack_v,
         sval_sid=zero(st.sval_sid),
         s_written=zero(st.s_written),
         s_read=zero(st.s_read),
+        skey_sid=zero(st.skey_sid),
+        s_wstep=zero(st.s_wstep),
+        s_mode=zero(st.s_mode),
         scount=zero(st.scount),
         skeys=zero(st.skeys),
         svals=zero(st.svals),
@@ -334,6 +343,7 @@ def _retire_gather_core(st: SymLaneState, rc, k: int, dstack: int,
         st.ssid[rc, :dstack],
         st.sval_sid[rc, :dslot], st.s_written[rc, :dslot],
         st.s_read[rc, :dslot],
+        st.skey_sid[rc, :dslot], st.s_wstep[rc, :dslot],
     ], axis=1)
     u32 = jnp.concatenate([
         flat(st.stack[rc, :dstack]),
@@ -419,7 +429,8 @@ def _unpack_rows(packed, dstack, dmem, dmlog, dslot) -> dict:
     for name, w in (("mlog_off", dmlog), ("mlog_len", dmlog),
                     ("mlog_sid", dmlog), ("ssid", dstack),
                     ("sval_sid", dslot), ("s_written", dslot),
-                    ("s_read", dslot)):
+                    ("s_read", dslot), ("skey_sid", dslot),
+                    ("s_wstep", dslot)):
         out[name] = i32[:, off:off + w]
         off += w
     off = 0
@@ -507,7 +518,8 @@ def _dedup_canon(st: SymLaneState, d_recs: int):
                 lax.bitcast_convert_type(f, jnp.uint32)
         for c in range(vals.shape[1]):
             h = h * jnp.uint32(0x9E3779B1) + vals[:, c]
-        cand = has & (op != _SSTORE_BYTE)
+        cand = has & (op != _SSTORE_BYTE) \
+            & (op != symstep.REC_SLOAD_RW)
         bucket = jnp.where(cand, (h % _DEDUP_H).astype(jnp.int32),
                            _DEDUP_H)
         win = jnp.full((_DEDUP_H,), intmax, jnp.int32)
@@ -550,6 +562,7 @@ def _canon_remap(st: SymLaneState, canon_pid, d_recs: int
     return st._replace(
         ssid=remap(st.ssid),
         sval_sid=remap(st.sval_sid),
+        skey_sid=remap(st.skey_sid),
         mlog_sid=remap(st.mlog_sid),
         flog_sid=remap(st.flog_sid),
     )
@@ -648,6 +661,7 @@ def _remap_reset_core(st: SymLaneState, prov_pairs) -> SymLaneState:
     return st._replace(
         ssid=remap(st.ssid),
         sval_sid=remap(st.sval_sid),
+        skey_sid=remap(st.skey_sid),
         mlog_sid=remap(st.mlog_sid),
         dlog_count=jnp.zeros_like(st.dlog_count),
         flog_count=jnp.zeros_like(st.flog_count),
@@ -1109,10 +1123,18 @@ _ALU3 = {"ADDMOD": alu.addmod, "MULMOD": alu.mulmod}
 _ARITY = {name: 2 for name in _ALU2}
 _ARITY.update({name: 3 for name in _ALU3})
 _ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
-               "SLOAD": 1, "CALLDATALOAD": 1})
+               "SLOAD": 1, "CALLDATALOAD": 1, "SHA3": 3})
 
 
-DEFAULT_WINDOW = 48
+#: steps per fused dispatch. The in-dispatch while_loop exits as soon
+#: as no lane is RUNNING, so a large window costs nothing when paths
+#: park early — but every extra dispatch pays a full round trip on a
+#: tunneled backend. Deep device paths (SHA3 defer + symbolic-storage
+#: mode keep token transfers on-device end-to-end) want whole
+#: transactions inside ONE window. Bounded by the deferred-log
+#: capacity only in the worst case (dlog_full parks, degraded not
+#: wrong).
+DEFAULT_WINDOW = 256
 DEFAULT_STEP_BUDGET = 8192
 
 
@@ -1527,6 +1549,52 @@ class LaneEngine:
         if opname == "SLOAD":
             return _storage_read_term(ctx.storage_seed_raw,
                                       alu.to_bitvec(args[0]))
+        if opname == "SHA3":
+            # device-read input words + packed meta (length + per-byte
+            # memory kinds). Rebuild the hash input byte-for-byte the
+            # way the interpreter's sha3_ handler reads Memory (ints
+            # for untouched/MSTORE8 bytes, 8-bit const terms for
+            # concrete-word bytes, Extract slices for symbolic words):
+            # the keccak input term tids then match the host exactly.
+            from ..smt import Concat
+            from .function_managers import keccak_function_manager
+
+            meta = alu.to_bitvec(args[2]).value
+            length = meta & 0xFFFFFFFF
+            all_sym_kinds = (1 << 64) - 1  # every 2-bit field == 3
+            byte_list: list = []
+            for w in range(length // 32):
+                kinds = (meta >> (32 + w * 64)) & all_sym_kinds
+                if kinds == all_sym_kinds:  # sid-carried word term
+                    word = args[w]
+                    if isinstance(word, Bool):
+                        word = If(word, _bv_val(1), _bv_val(0))
+                    byte_list.extend(
+                        simplify(Extract(255 - 8 * j, 248 - 8 * j,
+                                         word))
+                        for j in range(32))
+                    continue
+                word_int = alu.to_bitvec(args[w]).value or 0
+                raw = word_int.to_bytes(32, "big")
+                for j in range(32):
+                    kind = (kinds >> (2 * j)) & 3
+                    if kind == symstep.KIND_CONC_WORD:
+                        byte_list.append(
+                            symbol_factory.BitVecVal(raw[j], 8))
+                    else:
+                        byte_list.append(raw[j])
+            if all(isinstance(bb, int) for bb in byte_list):
+                data = symbol_factory.BitVecVal(
+                    int.from_bytes(bytes(byte_list), "big"),
+                    length * 8)
+            else:
+                parts = [
+                    bb if isinstance(bb, BitVec)
+                    else symbol_factory.BitVecVal(bb, 8)
+                    for bb in byte_list
+                ]
+                data = simplify(Concat(parts))
+            return keccak_function_manager.create_keccak(data)
         raise AssertionError(f"unresolvable deferred op {opname}")
 
     def _jumpi_site_work(self, ctx, lane, cond, step, byte_pc,
@@ -1582,21 +1650,45 @@ class LaneEngine:
         for _, kind, ev in events:
             if kind == 0:
                 step, lane, slot, op, pc, fentry, sids, vals = ev
-                opname = _OPN[op]
+                opname = "SLOAD_RW" if op == symstep.REC_SLOAD_RW \
+                    else _OPN[op]
                 ctx = ctxs[lane]
                 if opname == "SSTORE":
-                    # taint-sink record (never deduped): per-lane
-                    # promotion onto this lane's context
-                    if lane in dead_set:
-                        continue
+                    # write-mirror + taint-sink record (never deduped,
+                    # per-lane): the mirror feeds SLOAD_RW resolution
                     value = self._resolve_arg(sids[1], vals[1], prov,
                                               d_recs)
+                    key = self._resolve_arg(sids[0], vals[0], prov,
+                                            d_recs)
+                    ctx.swrites.append((alu.to_bitvec(key),
+                                        alu.to_bitvec(value)))
+                    if lane in dead_set:
+                        continue
                     site = _DrainSite(self, ctx, step, pc, fentry)
                     for ad in self.adapters:
                         for ann in ad.on_sstore(alu.to_bitvec(value),
-                                                site):
+                                                site,
+                                                alu.to_bitvec(key)):
                             ctx.promos.setdefault(id(ad), []).append(
                                 (step, ann))
+                    continue
+                if opname == "SLOAD_RW":
+                    # mode SLOAD: read-over-write over the per-path
+                    # mirror, folded onto the seed storage (the lane's
+                    # write history at this step is exactly
+                    # ctx.swrites — records replay in step order).
+                    # Never memoized: identical (key, pc) records on
+                    # different paths see different mirrors.
+                    key = alu.to_bitvec(self._resolve_arg(
+                        sids[0], vals[0], prov, d_recs))
+                    term = _storage_read_term(ctx.storage_seed_raw,
+                                              key)
+                    for wk, wv in ctx.swrites:
+                        term = If(wk == key, wv, term)
+                    term = simplify(term)
+                    if isinstance(term, Bool):
+                        term = If(term, _bv_val(1), _bv_val(0))
+                    prov[(lane, slot)] = self.objects.add(term)
                     continue
                 # cross-WINDOW dedup via the memo (the device already
                 # deduped within the window)
@@ -1893,22 +1985,52 @@ class LaneEngine:
         # store, and one read after a write (bit 2) replays one behind
         acct = gs.environment.active_account
         any_written = False
-        for r in range(int(st_host["scount"][lane])):
-            key = _bv_val(_limbs_int(st_host["skeys"][lane, r]))
-            written = int(st_host["s_written"][lane, r])
-            sread = int(st_host["s_read"][lane, r])
-            sid = int(st_host["sval_sid"][lane, r])
-            if sread & 1:
-                _ = acct.storage[key]
-            if written:
+        scount = int(st_host["scount"][lane])
+        entries = []
+        for r in range(scount):
+            sidk = int(st_host["skey_sid"][lane, r])
+            key = alu.to_bitvec(self._obj(sidk)) if sidk else \
+                _bv_val(_limbs_int(st_host["skeys"][lane, r]))
+            entries.append((
+                key,
+                int(st_host["s_written"][lane, r]),
+                int(st_host["s_read"][lane, r]),
+                int(st_host["sval_sid"][lane, r]),
+                r,
+                int(st_host["s_wstep"][lane, r]),
+                sidk,
+            ))
+
+        def _sval(r, sid):
+            if sid:
+                return self._obj(sid)
+            return _bv_val(_limbs_int(st_host["svals"][lane, r]))
+
+        if not any(e[6] for e in entries):
+            # concrete keys only: slot order == the historical replay
+            for key, written, sread, sid, r, _w, _k in entries:
+                if sread & 1:
+                    _ = acct.storage[key]
+                if written:
+                    any_written = True
+                    acct.storage[key] = _sval(r, sid)
+                if sread & 2:
+                    _ = acct.storage[key]
+        else:
+            # symbolic keys may alias: the host Storage builds the
+            # read-over-write term, so writes must replay in device
+            # step order (s_wstep) for later writes to shadow earlier
+            # maybe-equal ones
+            for key, written, sread, sid, r, _w, _k in entries:
+                if sread & 1:
+                    _ = acct.storage[key]
+            for key, written, sread, sid, r, _w, _k in sorted(
+                    (e for e in entries if e[1]), key=lambda e: e[5]):
                 any_written = True
-                if sid:
-                    acct.storage[key] = self._obj(sid)
-                else:
-                    acct.storage[key] = _bv_val(
-                        _limbs_int(st_host["svals"][lane, r]))
-            if sread & 2:
-                _ = acct.storage[key]
+                acct.storage[key] = _sval(r, sid)
+            for key, written, sread, sid, r, _w, _k in entries:
+                if sread & 2:
+                    _ = acct.storage[key]
         if any_written:
             # device-executed SSTOREs must leave the same mark the
             # mutation-pruner's SSTORE hook would have left, or clean-

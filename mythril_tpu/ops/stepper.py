@@ -119,6 +119,11 @@ def _build_tables():
         sup(name, 3, 1)
     sup("STOP", 0, 0)
     sup("POP", 1, 0)
+    # SHA3 executes only on the SYMBOLIC stepper (deferred keccak
+    # records); the concrete stepper keeps it unsupported, but the
+    # shared stack-effect tables need its pops/pushes
+    npop[_OP["SHA3"]] = 2
+    npush[_OP["SHA3"]] = 1
     sup("MLOAD", 1, 1)
     sup("MSTORE", 2, 0)
     sup("MSTORE8", 2, 0)

@@ -48,6 +48,7 @@ so materialized states carry exactly the gas the interpreter would have.
 """
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -114,8 +115,8 @@ def _build_sym_tables():
         executable[_OP[name]] = True
 
     for name in (
-        "POP MLOAD MSTORE MSTORE8 SLOAD SSTORE JUMP JUMPI JUMPDEST PC "
-        "MSIZE GAS CALLDATALOAD CALLDATASIZE CODESIZE"
+        "POP MLOAD MSTORE MSTORE8 SLOAD SSTORE SHA3 JUMP JUMPI "
+        "JUMPDEST PC MSIZE GAS CALLDATALOAD CALLDATASIZE CODESIZE"
     ).split():
         executable[_OP[name]] = True
     for name in ENV_SLOTS:
@@ -130,6 +131,19 @@ def _build_sym_tables():
 # back — a device_get through a tunneled chip costs seconds)
 (GAS_MIN_TABLE, GAS_MAX_TABLE, SYM_EXECUTABLE, DEFERRABLE) = \
     _build_sym_tables()
+
+#: pseudo-op byte (outside the 0-255 opcode space) marking a deferred
+#: read-over-write SLOAD record minted by a symbolic-storage-mode lane.
+#: Distinct from the plain SLOAD record (seed-storage select) because
+#: its resolution depends on the lane's per-path write mirror — such
+#: records must never dedup across lanes.
+REC_SLOAD_RW = 0x154
+
+#: triage kill-switches (read at trace time — set before the first
+#: window compiles): disable the SHA3 defer / symbolic-storage-mode
+#: fast paths to fall back to park-and-materialize behavior
+NO_SHA3_DEFER = os.environ.get("MTPU_NO_SHA3_DEFER") == "1"
+NO_STORAGE_MODE = os.environ.get("MTPU_NO_STORAGE_MODE") == "1"
 
 
 class SymLaneState(NamedTuple):
@@ -165,6 +179,17 @@ class SymLaneState(NamedTuple):
     s_read: jnp.ndarray        # (N, S) i32 bitmask: 1 = read before any
     #                            write, 2 = read after a write (both can
     #                            be set; drives keys_get replay parity)
+    skey_sid: jnp.ndarray      # (N, S) i32 — 0 = concrete key (limbs in
+    #                            skeys), else the key term's sid
+    s_wstep: jnp.ndarray       # (N, S) i32 — step_no of the slot's last
+    #                            SSTORE (materialize replays writes in
+    #                            this order: with maybe-aliasing symbolic
+    #                            keys, write order decides the term)
+    s_mode: jnp.ndarray        # (N,) i32 — 1 = symbolic-storage mode:
+    #                            the lane has touched a symbolic storage
+    #                            key; every SSTORE emits a mirror record
+    #                            and every SLOAD defers to a host-built
+    #                            read-over-write term (REC_SLOAD_RW)
     scount: jnp.ndarray        # (N,) i32
     sbase: jnp.ndarray         # (N,) i32 (0 = zero K-array base, else sym)
     calldata: jnp.ndarray      # (N, C) u8
@@ -242,6 +267,9 @@ def _init_sym_lanes_dev(
         sval_sid=z((n, storage_slots), jnp.int32),
         s_written=z((n, storage_slots), jnp.int32),
         s_read=z((n, storage_slots), jnp.int32),
+        skey_sid=z((n, storage_slots), jnp.int32),
+        s_wstep=z((n, storage_slots), jnp.int32),
+        s_mode=z((n,), jnp.int32),
         scount=z((n,), jnp.int32),
         sbase=z((n,), jnp.int32),
         calldata=z((n, calldata_bytes), jnp.uint8),
@@ -312,6 +340,25 @@ def _scatter_flat(arr, lane_mask, idx, value):
 
 def _peek_sid(ssid, sp, k):
     return _gather_flat(ssid, jnp.clip(sp - k, 0, ssid.shape[1] - 1))
+
+
+def _overlay_exact_hit(st, woff, mem_recs):
+    """(exact, sid) for the LAST overlay record overlapping the 32-byte
+    window at woff: exact iff that record covers the window precisely
+    (off == woff, len == 32). The single source of the exact-hit rule
+    shared by MLOAD resolution and SHA3 word reads — callers must also
+    require the window's kind bytes to be all-KIND_SYM_WORD."""
+    rec_ids = jnp.arange(mem_recs)[None, :]
+    live_rec = rec_ids < st.mlog_count[:, None]
+    ov = (live_rec & (st.mlog_off < (woff + 32)[:, None])
+          & ((st.mlog_off + st.mlog_len) > woff[:, None]))
+    last = jnp.max(jnp.where(ov, rec_ids + 1, 0), axis=1) - 1
+    lc = jnp.clip(last, 0, mem_recs - 1)
+    exact = ((last >= 0)
+             & (_gather_flat(st.mlog_off, lc) == woff)
+             & (_gather_flat(st.mlog_len, lc) == 32))
+    sid = jnp.where(exact, _gather_flat(st.mlog_sid, lc), 0)
+    return exact, sid
 
 
 def _mem_fee(old_bytes, new_bytes):
@@ -427,13 +474,24 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     is_jump = op == _OP["JUMP"]
     is_jumpi = op == _OP["JUMPI"]
     is_exp = op == _OP["EXP"]
+    is_sha3 = op == _OP["SHA3"]
 
     # ---- memory offsets / fees (needed before park resolution) -----------
+    # SHA3 with a concrete 32/64-byte length reads memory like MLOAD
+    # does (and extends msize / pays the fee); anything else about it
+    # parks (symbolic offset/length, odd lengths — the in-place resume
+    # path owns those)
+    sha3_len_u32, sha3_len_hi = _u32_of(b)
+    sha3_lenok = (
+        is_sha3 & ~sym_b & ~sha3_len_hi
+        & ((sha3_len_u32 == 32) | (sha3_len_u32 == 64)))
+    sha3_len = jnp.where(sha3_lenok, sha3_len_u32, 32).astype(jnp.int32)
     mem_off_u32, mem_off_hi = _u32_of(a)
     mem_big = mem_off_hi | (mem_off_u32 >= jnp.uint32(1 << 30))
     mem_off = jnp.where(mem_big, 0, mem_off_u32).astype(jnp.int32)
-    mem_ops = is_mload | is_mstore | is_mstore8
-    acc_len = jnp.where(is_mstore8, 1, 32)
+    mem_ops = is_mload | is_mstore | is_mstore8 | sha3_lenok
+    acc_len = jnp.where(is_mstore8, 1,
+                        jnp.where(is_sha3, sha3_len, 32))
     mem_end = mem_off + acc_len
     mem_oob = mem_ops & ~sym_a & (mem_big | (mem_end > mem_bytes))
     new_msize = jnp.where(
@@ -536,24 +594,9 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         kinds32 = jnp.take_along_axis(st.mkind, byte_idx32_c, axis=1)
         any_sym_byte = jnp.any(kinds32 == KIND_SYM_WORD, axis=1)
         all_sym_byte = jnp.all(kinds32 == KIND_SYM_WORD, axis=1)
-
-        rec_ids = jnp.arange(mem_recs)[None, :]
-        live_rec = rec_ids < st.mlog_count[:, None]
-        ov_sym = (
-            live_rec
-            & (st.mlog_off < mem_end[:, None])
-            & ((st.mlog_off + st.mlog_len) > mem_off[:, None])
-        )
-        last_sym = jnp.max(jnp.where(ov_sym, rec_ids + 1, 0), axis=1) - 1
-        ls_c = jnp.clip(last_sym, 0, mem_recs - 1)
-        ls_off = _gather_flat(st.mlog_off, ls_c)
-        ls_len = _gather_flat(st.mlog_len, ls_c)
-        ls_sid = _gather_flat(st.mlog_sid, ls_c)
-        exact = (
-            all_sym_byte & (last_sym >= 0)
-            & (ls_off == mem_off) & (ls_len == 32)
-        )
-        sym_sid = jnp.where(exact, ls_sid, 0)
+        hit, hit_sid = _overlay_exact_hit(st, mem_off, mem_recs)
+        exact = all_sym_byte & hit
+        sym_sid = jnp.where(exact, hit_sid, 0)
         park_ = is_mload & ~sym_a & ~mem_oob \
             & ~(exact | ~any_sym_byte)
         return exact, sym_sid, park_
@@ -566,31 +609,114 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # MSTORE of a symbolic word appends an overlay record
     mlog_full = sym_store_val & (st.mlog_count >= mem_recs)
 
+    # ---- SHA3 word reads (gated) ------------------------------------------
+    # A 32/64-byte SHA3 whose input words are each either fully
+    # concrete or an exact symbolic-overlay hit DEFERS: the record
+    # carries the word values/sids + the length, the host builds the
+    # keccak term at drain, and the lane keeps running with a
+    # provisional sid — no park. This is the mapping-slot hash pattern
+    # (MSTORE key; MSTORE slot; SHA3(off, 64)) that otherwise forces a
+    # park/resume round trip per hash.
+    def _sha3_decisions():
+        def word_read(woff):
+            bidx = woff[:, None] + jnp.arange(32)[None, :]
+            bidx_c = jnp.clip(bidx, 0, mem_bytes - 1)
+            kinds = jnp.take_along_axis(st.mkind, bidx_c, axis=1)
+            any_symb = jnp.any(kinds == KIND_SYM_WORD, axis=1)
+            all_symb = jnp.all(kinds == KIND_SYM_WORD, axis=1)
+            hit, hit_sid = _overlay_exact_hit(st, woff, mem_recs)
+            exact = all_symb & hit
+            sid = jnp.where(exact, hit_sid, 0)
+            raw = jnp.take_along_axis(st.memory, bidx_c, axis=1)
+            val = bytes_be_to_word(
+                jnp.where(bidx < mem_bytes, raw, 0))
+            # canonical record args: zero limbs when the sid carries
+            # the word (dedup hashes sids AND vals)
+            val = jnp.where(exact[:, None], 0, val)
+            # per-byte KIND_* bits (2 each), packed: the host rebuilds
+            # the hash input term byte-for-byte the way the
+            # interpreter's Memory would (ints vs 8-bit const terms vs
+            # Extract slices), so the keccak input tids match exactly.
+            # A sid-carried word reads all-KIND_SYM_WORD (every 2-bit
+            # field = 3) — unambiguous, since a value-carried word can
+            # never contain a SYM byte
+            k2 = jnp.where(exact[:, None], KIND_SYM_WORD,
+                           kinds.astype(jnp.uint32))
+            shifts = (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+            klo = jnp.sum(k2[:, :16] << shifts, axis=1,
+                          dtype=jnp.uint32)
+            khi = jnp.sum(k2[:, 16:] << shifts, axis=1,
+                          dtype=jnp.uint32)
+            return exact | ~any_symb, sid, val, klo, khi
+
+        ok0, sid0, val0, k0lo, k0hi = word_read(mem_off)
+        ok1, sid1, val1, k1lo, k1hi = word_read(mem_off + 32)
+        return (ok0, sid0, val0, k0lo, k0hi,
+                ok1, sid1, val1, k1lo, k1hi)
+
+    sha3_cand = running & sha3_lenok & ~sym_a & ~mem_oob & ~mem_big
+    zero_u = jnp.zeros((n,), jnp.uint32)
+    (s3_ok0, s3_sid0, s3_val0, s3_k0lo, s3_k0hi,
+     s3_ok1, s3_sid1, s3_val1, s3_k1lo, s3_k1hi) = lax.cond(
+        jnp.any(sha3_cand),
+        _sha3_decisions,
+        lambda: (zero_b, zero_i, zero_w, zero_u, zero_u,
+                 zero_b, zero_i, zero_w, zero_u, zero_u),
+    )
+    sha3_two = sha3_len == 64
+    sha3_defer = sha3_cand & s3_ok0 & (~sha3_two | s3_ok1)
+    if NO_SHA3_DEFER:
+        sha3_defer = sha3_defer & False
+
     # ---- storage decisions (gated: the key compare reads the whole
     # (N,S,8) log every evaluation) -----------------------------------------
     def _storage_decisions():
         slot_ids = jnp.arange(s_slots)[None, :]
-        key_match = jnp.all(st.skeys == a[:, None, :], axis=-1) \
-            & (slot_ids < st.scount[:, None])
+        live = slot_ids < st.scount[:, None]
+        # syntactic key equality: concrete keys by limbs (placeholder
+        # limbs of symbolic keys are excluded via skey_sid), symbolic
+        # keys by sid identity
+        conc_eq = (jnp.all(st.skeys == a[:, None, :], axis=-1)
+                   & (st.skey_sid == 0) & ~sym_a[:, None])
+        sym_eq = (st.skey_sid == sid_a[:, None]) & sym_a[:, None]
+        key_match = (conc_eq | sym_eq) & live
         match_score = jnp.where(key_match, slot_ids + 1, 0)
         best = jnp.max(match_score, axis=1)
         found = best > 0
         idx = jnp.clip(best - 1, 0, s_slots - 1)
+        any_written = jnp.any(live & (st.s_written > 0), axis=1)
         return (found, idx, _onehot_gather(st.svals, idx),
-                _gather_flat(st.sval_sid, idx))
+                _gather_flat(st.sval_sid, idx), any_written)
 
     any_storage_op = jnp.any(running & (is_sload | is_sstore))
-    s_found, s_idx, sload_hit_val, sload_hit_sid = lax.cond(
+    (s_found, s_idx, sload_hit_val, sload_hit_sid,
+     s_any_written) = lax.cond(
         any_storage_op,
         _storage_decisions,
-        lambda: (zero_b, zero_i, zero_w, zero_i),
+        lambda: (zero_b, zero_i, zero_w, zero_i, zero_b),
     )
-    sload_miss = is_sload & ~sym_a & ~s_found
-    # misses against a symbolic base defer to a select() term; misses
-    # against the zero K-array are concrete 0 — both are cached in the
-    # log (written=0) so materialization can replay keys_get
-    sload_miss_sym = sload_miss & (st.sbase != 0)
-    storage_insert = (is_sstore & ~sym_a & ~s_found) | sload_miss
+    # symbolic-storage mode: turns on at the lane's first symbolic-key
+    # access, but only while its write mirror is empty (mode records
+    # capture every write from this step on, so the host's per-path
+    # mirror is complete); with unrecorded prior writes the lane parks
+    # once and its descendants re-enter through the host interpreter
+    sym_key_op = (is_sload | is_sstore) & sym_a
+    mode_on_now = sym_key_op & (st.s_mode == 0) & ~s_any_written
+    mode_park = sym_key_op & (st.s_mode == 0) & s_any_written
+    if NO_STORAGE_MODE:
+        mode_on_now = mode_on_now & False
+        mode_park = sym_key_op & (st.s_mode == 0)
+    mode_eff = (st.s_mode != 0) | mode_on_now
+    # in mode every SLOAD defers to a host-built read-over-write term
+    # (the syntactic cache could be stale under maybe-aliasing writes;
+    # the host's If-chain folds exact matches back to the cached value)
+    sload_rw = is_sload & mode_eff
+    sload_miss = is_sload & ~s_found
+    # non-mode misses against a symbolic base defer to a select() term;
+    # misses against the zero K-array are concrete 0 — both are cached
+    # in the log (written=0) so materialization can replay keys_get
+    sload_miss_sym = sload_miss & ~mode_eff & (st.sbase != 0)
+    storage_insert = (is_sstore & ~s_found) | sload_miss
     storage_full = storage_insert & (st.scount >= s_slots)
 
     # ---- calldata ---------------------------------------------------------
@@ -607,12 +733,22 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # ---- deferral decision ------------------------------------------------
     defer = jnp.asarray(DEFERRABLE)[op] & any_sym
     defer = defer & ~(is_exp & ~exp_pure)  # impure EXP parks below
-    defer = defer | cdl_defer | sload_miss_sym | wrap_rec
-    dlog_full = (defer | sink_want) & (st.dlog_count >= d_recs)
+    defer = defer | cdl_defer | sload_miss_sym | wrap_rec \
+        | sha3_defer | sload_rw
+    # mode lanes record every SSTORE (key+value) so the host's
+    # per-path write mirror stays complete; taint sinks as before
+    sstore_rec_want = sink_want | (is_sstore & mode_eff)
+    dlog_full = (defer | sstore_rec_want) & (st.dlog_count >= d_recs)
 
     # ---- gas --------------------------------------------------------------
     gmin = jnp.asarray(GAS_MIN_TABLE)[op] + mem_fee
     gmax = jnp.asarray(GAS_MAX_TABLE)[op] + mem_fee
+    # deferred SHA3 has a concrete length: exact 30 + 6/word (the
+    # static table's interval is for unknown lengths)
+    sha3_fee = (jnp.uint32(30) + jnp.uint32(6)
+                * (sha3_len // 32).astype(jnp.uint32)) + mem_fee
+    gmin = jnp.where(sha3_defer, sha3_fee, gmin)
+    gmax = jnp.where(sha3_defer, sha3_fee, gmax)
     min_gas_after = st.min_gas + gmin
     oog = min_gas_after > st.gas_limit
 
@@ -633,8 +769,13 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         | mem_oob
         | mload_park
         | mlog_full
-        # storage
-        | ((is_sload | is_sstore) & sym_a)   # symbolic key
+        # SHA3 outside the defer envelope (symbolic offset/length, odd
+        # length, non-word-readable input) parks — the in-place resume
+        # path handles it host-side
+        | (is_sha3 & ~sha3_defer)
+        # storage: symbolic keys run in mode; the one park left is a
+        # first symbolic-key access over unrecorded prior writes
+        | mode_park
         | storage_full
         # calldata
         | (is_cdl & ~cd_symbolic & sym_a)
@@ -668,7 +809,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     park = park0 | fork_nocap
     ok = running & ~park & ~fork_stall
     defer = defer & ok
-    sink_rec = sink_want & ok
+    sink_rec = sstore_rec_want & ok
     logrec = defer | sink_rec
     fork_can = fork_can & ok
 
@@ -813,9 +954,14 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         new_val = jnp.where(do_sstore[:, None], b, zero_w)
         new_sid = jnp.where(
             do_sstore, sid_b,
-            jnp.where(sload_miss_sym, prov_id, 0))
+            jnp.where(sload_miss_sym | (sload_rw & sload_miss),
+                      prov_id, 0))
         new_written = jnp.where(do_sstore, 1, 0)
         sk = _scatter_word(st.skeys, do_write, pos_c, new_key)
+        skd = _scatter_flat(st.skey_sid, do_write, pos_c, sid_a)
+        swst = _scatter_flat(
+            st.s_wstep, do_sstore, pos_c,
+            jnp.full((n,), st.step_no, jnp.int32))
         sv = _scatter_word(st.svals, do_write, pos_c, new_val)
         ssd = _scatter_flat(st.sval_sid, do_write, pos_c, new_sid)
         # an SSTORE over a read-cache slot must mark it written; a cache
@@ -835,20 +981,22 @@ def sym_step(code: CompiledCode, st: SymLaneState,
             rd_bit | _gather_flat(st.s_read, pos_c),
         )
         sc = jnp.where(do_write & ~s_found, st.scount + 1, st.scount)
-        return sk, sv, ssd, swr, sr, sc, sload_v
+        return sk, skd, swst, sv, ssd, swr, sr, sc, sload_v
 
     # provisional id for this step's deferred record (used by storage
     # cache insertion and the result sid select)
     prov_id = -(lanes * d_recs + jnp.clip(st.dlog_count, 0, d_recs - 1)
                 + 1)
 
-    (skeys2, svals2, sval_sid2, s_written2, s_read2, scount2,
-     sload_r) = lax.cond(
+    (skeys2, skey_sid2, s_wstep2, svals2, sval_sid2, s_written2,
+     s_read2, scount2, sload_r) = lax.cond(
         jnp.any(ok & (is_sload | is_sstore)),
         _storage_block,
-        lambda: (st.skeys, st.svals, st.sval_sid, st.s_written,
-                 st.s_read, st.scount, zero_w),
+        lambda: (st.skeys, st.skey_sid, st.s_wstep, st.svals,
+                 st.sval_sid, st.s_written, st.s_read, st.scount,
+                 zero_w),
     )
+    s_mode2 = jnp.where(ok & mode_on_now, 1, st.s_mode)
 
     # ---- calldata execution (concrete path) -------------------------------
     def _calldata_block():
@@ -932,16 +1080,39 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
     # ---- deferred-record append (indexed row scatter: a dense one-hot
     # select would rewrite the whole (N,R,3,8) log plane every step) ------
+    # record-arg overrides: SHA3 records carry the input WORDS (not the
+    # popped offset/length) plus the length in slot 2; mode SLOADs are
+    # re-tagged REC_SLOAD_RW (dedup-exempt: resolution depends on the
+    # lane's write mirror)
+    rec_op = jnp.where(sload_rw, jnp.int32(REC_SLOAD_RW), op)
+    rec_sid0 = jnp.where(sha3_defer, s3_sid0, sid_a)
+    rec_sid1 = jnp.where(sha3_defer,
+                         jnp.where(sha3_two, s3_sid1, 0), sid_b)
+    rec_sid2 = jnp.where(sha3_defer, 0, sid_c)
+    rec_val0 = jnp.where(sha3_defer[:, None], s3_val0, a)
+    rec_val1 = jnp.where(
+        sha3_defer[:, None],
+        jnp.where((sha3_two & (s3_sid1 == 0))[:, None], s3_val1, 0), b)
+    # SHA3 meta word: [length, word0 kinds lo/hi, word1 kinds lo/hi]
+    # in the first five u32 limbs (limbs are LSB-first)
+    sha3_meta = jnp.stack(
+        [sha3_len.astype(jnp.uint32), s3_k0lo, s3_k0hi,
+         jnp.where(sha3_two, s3_k1lo, 0),
+         jnp.where(sha3_two, s3_k1hi, 0),
+         jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.uint32),
+         jnp.zeros((n,), jnp.uint32)], axis=-1)
+    rec_val2 = jnp.where(sha3_defer[:, None], sha3_meta, c)
+
     def _dlog_append():
         pos = jnp.where(logrec, jnp.clip(st.dlog_count, 0, d_recs - 1),
                         d_recs)  # drop for non-logging lanes
-        dop = st.dlog_op.at[lanes, pos].set(op, mode="drop")
+        dop = st.dlog_op.at[lanes, pos].set(rec_op, mode="drop")
         dpc = st.dlog_pc.at[lanes, pos].set(st.pc, mode="drop")
         dstep = st.dlog_step.at[lanes, pos].set(
             jnp.full((n,), st.step_no, jnp.int32), mode="drop")
         dfen = st.dlog_fentry.at[lanes, pos].set(st.fentry, mode="drop")
-        sids = jnp.stack([sid_a, sid_b, sid_c], axis=-1)  # (N, 3)
-        vals = jnp.stack([a, b, c], axis=1)               # (N, 3, 8)
+        sids = jnp.stack([rec_sid0, rec_sid1, rec_sid2], axis=-1)
+        vals = jnp.stack([rec_val0, rec_val1, rec_val2], axis=1)
         dsid = st.dlog_sid.at[lanes, pos].set(sids, mode="drop")
         dval = st.dlog_val.at[lanes, pos].set(vals, mode="drop")
         dcount = jnp.where(logrec, st.dlog_count + 1, st.dlog_count)
@@ -998,6 +1169,9 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         mlog_sid=mlog_sid2,
         mlog_count=mlog_count2,
         skeys=skeys2,
+        skey_sid=skey_sid2,
+        s_wstep=s_wstep2,
+        s_mode=s_mode2,
         svals=svals2,
         sval_sid=sval_sid2,
         s_written=s_written2,
@@ -1091,8 +1265,12 @@ def sym_run(code: CompiledCode, st: SymLaneState, max_steps: int,
             exec_table: jnp.ndarray = None,
             taint_table: jnp.ndarray = None,
             visited: jnp.ndarray = None):
-    """Run up to max_steps (one sync window). max_steps must not exceed
-    the deferred-log capacity (one record per lane per step).
+    """Run up to max_steps (one sync window; exits early once no lane
+    is RUNNING). max_steps MAY exceed the deferred-log capacity: a lane
+    that would mint a record with its log full parks (dlog_full ->
+    NEEDS_HOST) before appending — degraded to a host round trip, never
+    wrong. Records are only minted for symbolic/deferred work, so the
+    default window (lane_engine.DEFAULT_WINDOW) rarely hits the cap.
 
     `visited` is an optional per-byte-address coverage bitmap (device
     resident, accumulated across windows): each step marks the pc of

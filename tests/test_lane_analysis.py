@@ -88,3 +88,44 @@ def test_lane_report_parity(file_name, module, tx_count, issue_count):
         if isinstance(lane, dict) else None
     if issues is not None and issue_count is not None:
         assert issues == issue_count
+
+
+def test_arbitrary_write_symbolic_key_device_parity():
+    """SSTORE with an attacker-controlled (symbolic) key executes
+    device-side under symbolic-storage mode; the ArbitraryStorage
+    adapter must still surface the module's High-severity issue
+    exactly as the host interpreter does."""
+    # storage[calldata[0]] = 42; STOP
+    code = bytes.fromhex("602a600035") + bytes([0x55, 0x00])
+    reports = []
+    for lanes in (0, 64):
+        disassembler = MythrilDisassembler(eth=None)
+        address, _ = disassembler.load_from_bytecode(
+            code.hex(), bin_runtime=True)
+        cmd_args = SimpleNamespace(
+            execution_timeout=300, max_depth=128, solver_timeout=60000,
+            no_onchain_data=True, loop_bound=3, create_timeout=10,
+            pruning_factor=None, unconstrained_storage=False,
+            parallel_solving=False, call_depth_limit=3,
+            disable_dependency_pruning=False,
+            custom_modules_directory="", solver_log=None,
+            transaction_sequences=None,
+        )
+        analyzer = MythrilAnalyzer(
+            disassembler=disassembler, cmd_args=cmd_args,
+            strategy="bfs", address=address,
+        )
+        old = global_args.tpu_lanes
+        global_args.tpu_lanes = lanes
+        try:
+            report = analyzer.fire_lasers(
+                modules=["ArbitraryStorage"], transaction_count=1)
+        finally:
+            global_args.tpu_lanes = old
+        reports.append(_strip_volatile(
+            json.loads(report.as_swc_standard_format())))
+    host, lane = reports
+    assert host and host[0]["issues"], "host must find the write"
+    assert host[0]["issues"][0]["swcID"].endswith("124")
+    assert lane and lane[0]["issues"], "lane must find the write"
+    assert len(lane[0]["issues"]) == len(host[0]["issues"])

@@ -17,22 +17,47 @@ def _fresh_stats():
 
 def _warm(n_lanes, code):
     for bucket in (16, n_lanes):
-        lane_engine.warm_variant(n_lanes, len(code), {}, 48, 8192,
+        lane_engine.warm_variant(n_lanes, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
                                  seed_bucket=bucket, block=True)
 
 
-def test_sha3_parks_resume_in_place():
+def test_sha3_word_hashes_defer_without_parking():
+    # the bench workload's SHA3 tail is a word-aligned 32-byte hash:
+    # since the device defers those as keccak records, NO lane should
+    # park or resume at SHA3 anymore — the whole tree runs device-side
     code, n_paths = bench.build_symbolic_contract(k=6)
     _warm(16, code)
     lane_s, lane_paths = bench._explore(code, 16)
     host_s, host_paths = bench._explore(code, 0)
     assert lane_paths == host_paths == n_paths
     stats = lane_engine.RUN_STATS_TOTAL
-    # every path hits the SHA3 tail once; the engine must resume at
-    # least a wave of those parks on device rather than round-tripping
-    # them through the host (on an undersized engine the spill/refill
-    # path still reseeds the overflow, so only a floor is asserted)
-    assert stats.get("resumed", 0) >= 8
+    assert stats.get("resumed", 0) == 0
+
+
+def test_sha3_odd_length_parks_and_resumes_in_place():
+    # a 33-byte hash is outside the defer envelope (not 32/64): the
+    # lane parks at SHA3 and the in-place resume path must patch it on
+    # device (host-built keccak term), with host-identical results
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray()
+    c += push(0) + bytes([op["CALLDATALOAD"]])
+    c += push(0) + bytes([op["MSTORE"]])
+    c += push(7) + push(32) + bytes([op["MSTORE8"]])
+    c += push(33) + push(0) + bytes([op["SHA3"]])
+    c += push(99) + bytes([op["SSTORE"], op["STOP"]])
+    code = bytes(c)
+    _warm(16, code)
+    lane_s, lane_paths = bench._explore(code, 16)
+    host_s, host_paths = bench._explore(code, 0)
+    assert lane_paths == host_paths
+    stats = lane_engine.RUN_STATS_TOTAL
+    assert stats.get("resumed", 0) >= 1
 
 
 def test_resume_declines_when_sha3_hooked():
