@@ -28,11 +28,18 @@ inline Var var_of(Lit l) { return l >> 1; }
 inline bool sign_of(Lit l) { return l & 1; }
 inline Lit neg(Lit l) { return l ^ 1; }
 
+// Clause metadata; literals live in one flat arena (clauses of a
+// Tseitin-blasted instance are small and access-heavy — per-clause
+// heap vectors made every propagation step a pointer chase; the arena
+// keeps the hot loop cache-resident). Binary clauses never enter the
+// arena at all: they are stored inline in their watch lists and
+// propagate without touching clause memory.
 struct Clause {
+  uint32_t off = 0;
+  uint32_t size = 0;
   float act = 0.f;
   uint32_t lbd = 0;
   bool learnt = false;
-  std::vector<Lit> lits;
 };
 
 struct Watch {
@@ -40,13 +47,23 @@ struct Watch {
   Lit blocker;
 };
 
+// conflict "cref" marker for a binary-clause conflict (lits in
+// Solver::bin_confl); reason[] marker for a binary-implied literal
+// (antecedent in Solver::reason_bin)
+enum { CREF_NONE = -1, CREF_BIN = -2 };
+
 struct Solver {
-  std::vector<Clause> clauses;        // problem + learnt
-  std::vector<int> free_crefs;        // recycled slots
-  std::vector<std::vector<Watch>> watches;  // per literal
+  std::vector<Clause> clauses;        // problem + learnt (metadata)
+  std::vector<Lit> arena;             // all non-binary clause literals
+  size_t arena_waste = 0;             // freed literals awaiting compact
+  std::vector<int> free_crefs;        // recycled metadata slots
+  std::vector<std::vector<Watch>> watches;  // per literal (len >= 3)
+  std::vector<std::vector<Lit>> bin_watches;  // per literal: the OTHER
+  //                                             lit of each binary
   std::vector<int8_t> assign;         // per var
   std::vector<int> level;
-  std::vector<int> reason;            // cref or -1
+  std::vector<int> reason;            // cref, CREF_NONE or CREF_BIN
+  std::vector<Lit> reason_bin;        // antecedent lit when CREF_BIN
   std::vector<Lit> trail;
   std::vector<int> trail_lim;
   std::vector<double> activity;
@@ -54,6 +71,7 @@ struct Solver {
   std::vector<int> heap;              // binary max-heap of vars
   std::vector<int> heap_pos;          // var -> heap index or -1
   std::vector<uint8_t> seen;
+  Lit bin_confl[2] = {0, 0};          // conflict lits when CREF_BIN
   double var_inc = 1.0;
   double cla_inc = 1.0;
   int qhead = 0;
@@ -62,6 +80,22 @@ struct Solver {
   int64_t learnt_count = 0;
   std::vector<Lit> assumptions;
   std::vector<Lit> add_tmp;
+
+  inline Lit* lits(int cref) { return arena.data() + clauses[cref].off; }
+  // literal view of a conflict/reason reference. `implied` is the
+  // clause's first literal (the implied one) — only meaningful for
+  // CREF_BIN reasons, where the stored antecedent supplies lits[1].
+  inline const Lit* ref_lits(int ref, Lit implied, int& sz) {
+    if (ref == CREF_BIN) {
+      bin_scratch[0] = implied;
+      bin_scratch[1] = reason_bin[var_of(implied)];
+      sz = 2;
+      return bin_scratch;
+    }
+    sz = (int)clauses[ref].size;
+    return arena.data() + clauses[ref].off;
+  }
+  Lit bin_scratch[2] = {0, 0};
 
   // --- variable order heap -------------------------------------------------
   bool heap_lt(Var a, Var b) { return activity[a] > activity[b]; }
@@ -114,13 +148,16 @@ struct Solver {
     Var v = (Var)assign.size();
     assign.push_back(U);
     level.push_back(0);
-    reason.push_back(-1);
+    reason.push_back(CREF_NONE);
+    reason_bin.push_back(0);
     activity.push_back(0.0);
     saved_phase.push_back(F);  // default polarity false: zeros-biased models
     heap_pos.push_back(-1);
     seen.push_back(0);
     watches.emplace_back();
     watches.emplace_back();
+    bin_watches.emplace_back();
+    bin_watches.emplace_back();
     heap_insert(v);
     return v;
   }
@@ -148,9 +185,14 @@ struct Solver {
   }
 
   void attach(int cref) {
-    Clause& c = clauses[cref];
-    watches[neg(c.lits[0])].push_back({cref, c.lits[1]});
-    watches[neg(c.lits[1])].push_back({cref, c.lits[0]});
+    Lit* cl = lits(cref);
+    watches[neg(cl[0])].push_back({cref, cl[1]});
+    watches[neg(cl[1])].push_back({cref, cl[0]});
+  }
+
+  void attach_binary(Lit a, Lit b) {
+    bin_watches[neg(a)].push_back(b);
+    bin_watches[neg(b)].push_back(a);
   }
 
   void uncheck_enqueue(Lit l, int from) {
@@ -159,11 +201,31 @@ struct Solver {
     reason[var_of(l)] = from;
     trail.push_back(l);
   }
+  void enqueue_binary(Lit l, Lit antecedent) {
+    assign[var_of(l)] = sign_of(l) ? F : T;
+    level[var_of(l)] = (int)trail_lim.size();
+    reason[var_of(l)] = CREF_BIN;
+    reason_bin[var_of(l)] = antecedent;
+    trail.push_back(l);
+  }
 
-  int propagate() {  // returns conflicting cref or -1
+  int propagate() {  // returns conflicting cref, CREF_BIN or CREF_NONE
     while (qhead < (int)trail.size()) {
       Lit p = trail[qhead++];
       ++propagations;
+      // binary clauses first: no clause memory touched at all
+      const std::vector<Lit>& bs = bin_watches[p];
+      for (size_t i = 0; i < bs.size(); ++i) {
+        Lit other = bs[i];
+        int8_t v = value(other);
+        if (v == F) {
+          bin_confl[0] = other;
+          bin_confl[1] = neg(p);
+          qhead = (int)trail.size();
+          return CREF_BIN;
+        }
+        if (v == U) enqueue_binary(other, neg(p));
+      }
       std::vector<Watch>& ws = watches[p];
       size_t i = 0, j = 0;
       while (i < ws.size()) {
@@ -173,19 +235,20 @@ struct Solver {
           continue;
         }
         Clause& c = clauses[w.cref];
+        Lit* cl = arena.data() + c.off;
         Lit false_lit = neg(p);
-        if (c.lits[0] == false_lit) std::swap(c.lits[0], c.lits[1]);
-        Lit first = c.lits[0];
+        if (cl[0] == false_lit) std::swap(cl[0], cl[1]);
+        Lit first = cl[0];
         if (first != w.blocker && value(first) == T) {
           ws[j++] = {w.cref, first};
           ++i;
           continue;
         }
         bool moved = false;
-        for (size_t k = 2; k < c.lits.size(); ++k) {
-          if (value(c.lits[k]) != F) {
-            std::swap(c.lits[1], c.lits[k]);
-            watches[neg(c.lits[1])].push_back({w.cref, first});
+        for (uint32_t k = 2; k < c.size; ++k) {
+          if (value(cl[k]) != F) {
+            std::swap(cl[1], cl[k]);
+            watches[neg(cl[1])].push_back({w.cref, first});
             moved = true;
             break;
           }
@@ -208,7 +271,7 @@ struct Solver {
       }
       ws.resize(j);
     }
-    return -1;
+    return CREF_NONE;
   }
 
   void cancel_until(int lvl) {
@@ -235,16 +298,21 @@ struct Solver {
       Lit cur = stack.back();
       stack.pop_back();
       int r = reason[var_of(cur)];
-      if (r < 0) {
+      if (r == CREF_NONE) {
         for (Var v : cleared) seen[v] = 0;
         return false;
       }
-      Clause& c = clauses[r];
-      for (size_t i = 1; i < c.lits.size(); ++i) {
-        Lit q = c.lits[i];
+      // the implied literal of cur's reason clause is the trail
+      // assignment of cur's var (cur may appear negated here)
+      Lit implied = mklit(var_of(cur), assign[var_of(cur)] == F);
+      int sz;
+      const Lit* cl = ref_lits(r, implied, sz);
+      for (int i = 1; i < sz; ++i) {
+        Lit q = cl[i];
         Var v = var_of(q);
         if (seen[v] || level[v] == 0) continue;
-        if (reason[v] < 0 || !((levels_mask >> (level[v] & 31)) & 1)) {
+        if (reason[v] == CREF_NONE ||
+            !((levels_mask >> (level[v] & 31)) & 1)) {
           for (Var vv : cleared) seen[vv] = 0;
           return false;
         }
@@ -268,10 +336,21 @@ struct Solver {
     Lit p = -1;
     int idx = (int)trail.size() - 1;
     do {
-      Clause& c = clauses[confl];
-      if (c.learnt) cla_bump(c);
-      for (size_t i = (p == -1 ? 0 : 1); i < c.lits.size(); ++i) {
-        Lit q = c.lits[i];
+      if (confl != CREF_BIN && clauses[confl].learnt)
+        cla_bump(clauses[confl]);
+      int sz;
+      const Lit* cl;
+      if (p == -1 && confl == CREF_BIN) {
+        // initial conflict in a binary clause: both lits false
+        bin_scratch[0] = bin_confl[0];
+        bin_scratch[1] = bin_confl[1];
+        sz = 2;
+        cl = bin_scratch;
+      } else {
+        cl = ref_lits(confl, p, sz);
+      }
+      for (int i = (p == -1 ? 0 : 1); i < sz; ++i) {
+        Lit q = cl[i];
         Var v = var_of(q);
         if (!seen[v] && level[v] > 0) {
           seen[v] = 1;
@@ -297,7 +376,8 @@ struct Solver {
     size_t j = 1;
     for (size_t i = 1; i < out_learnt.size(); ++i) {
       Var v = var_of(out_learnt[i]);
-      if (reason[v] < 0 || !lit_redundant(out_learnt[i], levels_mask))
+      if (reason[v] == CREF_NONE ||
+          !lit_redundant(out_learnt[i], levels_mask))
         out_learnt[j++] = out_learnt[i];
       else
         minimize_marked.push_back(v);  // dropped literal still has seen=1
@@ -330,7 +410,7 @@ struct Solver {
     minimize_marked.clear();
   }
 
-  int alloc_clause(const std::vector<Lit>& lits, bool learnt) {
+  int alloc_clause(const std::vector<Lit>& cl, bool learnt) {
     int cref;
     if (!free_crefs.empty()) {
       cref = free_crefs.back();
@@ -340,8 +420,10 @@ struct Solver {
       cref = (int)clauses.size();
       clauses.emplace_back();
     }
-    clauses[cref].lits = lits;
+    clauses[cref].off = (uint32_t)arena.size();
+    clauses[cref].size = (uint32_t)cl.size();
     clauses[cref].learnt = learnt;
+    arena.insert(arena.end(), cl.begin(), cl.end());
     return cref;
   }
 
@@ -371,9 +453,13 @@ struct Solver {
         ok = false;
         return false;
       }
-      if (value(cl[0]) == U) uncheck_enqueue(cl[0], -1);
-      ok = (propagate() == -1);
+      if (value(cl[0]) == U) uncheck_enqueue(cl[0], CREF_NONE);
+      ok = (propagate() == CREF_NONE);
       return ok;
+    }
+    if (cl.size() == 2) {
+      attach_binary(cl[0], cl[1]);
+      return true;
     }
     int cref = alloc_clause(cl, false);
     attach(cref);
@@ -381,9 +467,9 @@ struct Solver {
   }
 
   void detach(int cref) {
-    Clause& c = clauses[cref];
+    Lit* cl = lits(cref);
     for (int wi = 0; wi < 2; ++wi) {
-      std::vector<Watch>& ws = watches[neg(c.lits[wi])];
+      std::vector<Watch>& ws = watches[neg(cl[wi])];
       for (size_t i = 0; i < ws.size(); ++i)
         if (ws[i].cref == cref) {
           ws[i] = ws.back();
@@ -394,14 +480,28 @@ struct Solver {
   }
 
   bool locked(int cref) {
-    const Clause& c = clauses[cref];
-    return value(c.lits[0]) == T && reason[var_of(c.lits[0])] == cref;
+    Lit first = lits(cref)[0];
+    return value(first) == T && reason[var_of(first)] == cref;
+  }
+
+  void compact_arena() {
+    std::vector<Lit> fresh;
+    fresh.reserve(arena.size() - arena_waste);
+    for (auto& c : clauses) {
+      if (c.size == 0) continue;
+      uint32_t off = (uint32_t)fresh.size();
+      fresh.insert(fresh.end(), arena.begin() + c.off,
+                   arena.begin() + c.off + c.size);
+      c.off = off;
+    }
+    arena.swap(fresh);
+    arena_waste = 0;
   }
 
   void reduce_db() {
     std::vector<int> learnts;
     for (int i = 0; i < (int)clauses.size(); ++i)
-      if (clauses[i].learnt && !clauses[i].lits.empty()) learnts.push_back(i);
+      if (clauses[i].learnt && clauses[i].size) learnts.push_back(i);
     std::sort(learnts.begin(), learnts.end(), [&](int a, int b) {
       const Clause& x = clauses[a];
       const Clause& y = clauses[b];
@@ -413,11 +513,12 @@ struct Solver {
       int cref = learnts[i];
       if (locked(cref) || clauses[cref].lbd <= 3) continue;
       detach(cref);
-      clauses[cref].lits.clear();
-      clauses[cref].lits.shrink_to_fit();
+      arena_waste += clauses[cref].size;
+      clauses[cref].size = 0;
       free_crefs.push_back(cref);
       --learnt_count;
     }
+    if (arena_waste > arena.size() / 2) compact_arena();
   }
 
   static double luby(double y, int x) {
@@ -470,7 +571,7 @@ struct Solver {
 
     for (;;) {
       int confl = propagate();
-      if (confl >= 0) {
+      if (confl != CREF_NONE) {
         ++conflicts;
         // A conflict while only assumption decisions are on the trail (each
         // assumption occupies exactly one decision level) means the formula
@@ -489,7 +590,13 @@ struct Solver {
         cancel_until(btlevel);
         if (learnt_cl.size() == 1) {
           // btlevel == 0 here; assumptions get re-asserted by the loop below
-          if (value(learnt_cl[0]) == U) uncheck_enqueue(learnt_cl[0], -1);
+          if (value(learnt_cl[0]) == U)
+            uncheck_enqueue(learnt_cl[0], CREF_NONE);
+        } else if (learnt_cl.size() == 2) {
+          // learnt binaries join the inline watch lists (never
+          // reduced: lbd <= 2 clauses were kept by reduce_db anyway)
+          attach_binary(learnt_cl[0], learnt_cl[1]);
+          enqueue_binary(learnt_cl[0], learnt_cl[1]);
         } else {
           int cref = alloc_clause(learnt_cl, true);
           clauses[cref].lbd = lbd;
