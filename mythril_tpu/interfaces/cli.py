@@ -263,6 +263,11 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
                              "host-only otherwise; 0 = host-only "
                              "reference engine; >0 = JAX/TPU batched "
                              "execution with N lanes)")
+    options.add_argument("--tpu-mesh", type=int,
+                        default=global_args.tpu_mesh,
+                        help="Shard lane planes over a device mesh "
+                             "(-1 = auto: all local devices when >1; "
+                             "0 = single device; N = use N devices)")
     options.add_argument("--no-tpu-prefilter", action="store_true",
                         help="Disable the on-device interval/bit "
                              "constraint pre-filter")

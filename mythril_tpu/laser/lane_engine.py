@@ -1151,6 +1151,30 @@ PATH_HISTORY: Dict[bytes, int] = {}
 FORCE_WIDTH: Optional[int] = None
 
 
+def pick_mesh(width: int):
+    """Device mesh for a sweep under the args.tpu_mesh policy, or None
+    for single-device execution. Auto (-1) shards over every local
+    device when more than one exists; the width must divide evenly and
+    leave at least 8 lanes per shard (narrower shards pay collective
+    overhead for no batching win). Single-chip hosts — including the
+    tunneled-TPU driver environment — always resolve to None."""
+    from ..support.support_args import args
+
+    setting = getattr(args, "tpu_mesh", -1)
+    if setting == 0:
+        return None
+    nd = jax.device_count()
+    if setting > 0:
+        nd = min(setting, nd)
+    while nd > 1 and (width % nd or width // nd < 8):
+        nd -= 1
+    if nd <= 1:
+        return None
+    from ..parallel.mesh import make_mesh
+
+    return make_mesh(nd)
+
+
 def pick_width(cap: int, n_entries: int,
                code: Optional[bytes] = None) -> int:
     """Engine width for a sweep: the smallest power-of-two bucket with
@@ -1181,11 +1205,32 @@ class LaneEngine:
 
     def __init__(self, n_lanes: int = 256, window: int = DEFAULT_WINDOW,
                  step_budget: int = DEFAULT_STEP_BUDGET,
-                 blocked_ops=None, adapters=None, **lane_kwargs):
+                 blocked_ops=None, adapters=None, mesh=None,
+                 **lane_kwargs):
         self.n_lanes = n_lanes
         self.window = window
         self.step_budget = step_budget
         self.lane_kwargs = lane_kwargs
+        # multi-device SPMD: when a jax.sharding.Mesh is supplied, the
+        # lane planes live sharded over its `lanes` axis and every
+        # fused dispatch runs SPMD under GSPMD partitioning — the SAME
+        # jitted programs, with XLA inserting the (rare) cross-device
+        # collectives the cumsum/scatter phases need. The host bridge
+        # (seed/drain/materialize) is unchanged: device_get gathers.
+        self.mesh = mesh
+        self._lane_sh = self._rep_sh = None
+        if mesh is not None:
+            from ..parallel.mesh import lane_sharding, replicated
+
+            if n_lanes % mesh.devices.size:
+                raise ValueError(
+                    f"{n_lanes} lanes not divisible by "
+                    f"{mesh.devices.size} mesh devices")
+            self._lane_sh = lane_sharding(mesh)
+            self._rep_sh = replicated(mesh)
+        #: per-code replicated compiled-code tensors (engines persist
+        #: across explores; re-broadcasting cc each sweep is waste)
+        self._cc_rep: Dict[bytes, object] = {}
         #: device-resident / host coverage bitmaps per code (see explore)
         self._visited_dev: Dict[bytes, object] = {}
         self.visited_by_code: Dict[bytes, np.ndarray] = {}
@@ -2073,6 +2118,23 @@ class LaneEngine:
         ) if entry_states else {}
         stats0 = dict(self.stats)  # engines persist across explores
         cc = _compiled_code(code_bytes, self._func_names.keys())
+        if self._rep_sh is not None:
+            # SPMD mode: code tensors (and the op tables) replicate
+            # across the mesh so the sharded dispatch sees consistent
+            # placements; memoized per code — engines persist across
+            # explores and must not re-broadcast every sweep
+            cc_r = self._cc_rep.get(code_bytes)
+            if cc_r is None:
+                cc_r = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, self._rep_sh), cc)
+                self._cc_rep[code_bytes] = cc_r
+                self.exec_table = jax.device_put(self.exec_table,
+                                                 self._rep_sh)
+                self.taint_table = jax.device_put(self.taint_table,
+                                                  self._rep_sh)
+                self._resume_flag = jax.device_put(self._resume_flag,
+                                                   self._rep_sh)
+            cc = cc_r
         # per-byte-address coverage bitmap, device-resident across
         # windows AND explores of the same code (the interpreter's
         # execute_state coverage hook cannot see device steps; this is
@@ -2328,15 +2390,28 @@ class LaneEngine:
     # -- device-state pooling ------------------------------------------------
 
     def _shape_key(self) -> tuple:
-        return (self.n_lanes,) + tuple(sorted(self.lane_kwargs.items()))
+        mesh_key = None
+        if self.mesh is not None:
+            mesh_key = tuple(d.id for d in self.mesh.devices.flat)
+        return (self.n_lanes, mesh_key) \
+            + tuple(sorted(self.lane_kwargs.items()))
 
     def _acquire_state(self) -> SymLaneState:
         pool = _STATE_POOL.get(self._shape_key())
         if pool:
             return pool.pop()
         with _prof("init_lanes"):
-            return symstep.init_sym_lanes(self.n_lanes,
-                                          **self.lane_kwargs)
+            st = symstep.init_sym_lanes(self.n_lanes,
+                                        **self.lane_kwargs)
+            if self._lane_sh is not None:
+                st = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(
+                        x, self._lane_sh
+                        if getattr(x, "ndim", 0) > 0
+                        and x.shape[0] == self.n_lanes
+                        else self._rep_sh),
+                    st)
+            return st
 
     def _release_state(self, st: SymLaneState) -> None:
         """Park the (all-DEAD) device buffers for the next explore —

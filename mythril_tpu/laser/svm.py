@@ -406,7 +406,7 @@ class LaserEVM:
         if cache is None:
             cache = self._lane_engines = {}
         from .lane_engine import (
-            DEFAULT_STEP_BUDGET, DEFAULT_WINDOW, pick_width,
+            DEFAULT_STEP_BUDGET, DEFAULT_WINDOW, pick_mesh, pick_width,
             warm_variant,
         )
 
@@ -427,22 +427,27 @@ class LaserEVM:
                                 DEFAULT_WINDOW, DEFAULT_STEP_BUDGET):
                 self.work_list.extend(states)
                 continue
-            key = (code, width, frozenset(blocked),
+            mesh = pick_mesh(width)
+            key = (code, width,
+                   mesh.devices.size if mesh is not None else 0,
+                   frozenset(blocked),
                    tuple(id(a) for a in adapters))
             try:
                 engine = cache.get(key)
                 if engine is None:
                     engine = LaneEngine(n_lanes=width,
                                         blocked_ops=blocked,
-                                        adapters=adapters)
+                                        adapters=adapters,
+                                        mesh=mesh)
                     cache[key] = engine
                     # keep at most two widths per code: drop the
                     # narrowest surplus engine (its pooled device
                     # planes stay in the bounded global pool)
                     same = [k for k in cache
-                            if k[0] == code and k[2:] == key[2:]]
+                            if k[0] == code and k[3:] == key[3:]]
                     if len(same) > 2:
-                        del cache[min(same, key=lambda k: k[1])]
+                        # evict the narrowest (width, mesh) variant
+                        del cache[min(same, key=lambda k: (k[1], k[2]))]
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(

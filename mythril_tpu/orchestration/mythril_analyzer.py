@@ -73,6 +73,7 @@ class MythrilAnalyzer:
             cmd_args, "transaction_sequences", None
         )
         args.tpu_lanes = getattr(cmd_args, "tpu_lanes", args.tpu_lanes)
+        args.tpu_mesh = getattr(cmd_args, "tpu_mesh", args.tpu_mesh)
         args.checkpoint_file = getattr(cmd_args, "checkpoint", None)
         from ..support.devices import effective_tpu_lanes
 

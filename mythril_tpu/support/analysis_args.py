@@ -27,6 +27,7 @@ def make_cmd_args(**overrides) -> SimpleNamespace:
         solver_log=None,
         transaction_sequences=None,
         tpu_lanes=0,
+        tpu_mesh=-1,
         checkpoint=None,
     )
     unknown = set(overrides) - set(base)

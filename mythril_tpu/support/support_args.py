@@ -32,6 +32,10 @@ class Args(object, metaclass=Singleton):
         # otherwise — support/devices.default_tpu_lanes); 0 = host-only
         # engine; >0 = batched lane engine with that width
         self.tpu_lanes = -1
+        # -1 = auto (shard the lane planes over all local devices when
+        # more than one exists and the width divides evenly); 0 = single
+        # device; >0 = shard over that many devices (parallel/mesh.py)
+        self.tpu_mesh = -1
         self.tpu_prefilter = True
         # transaction-boundary checkpoint/resume (support/checkpoint.py)
         self.checkpoint_file = None

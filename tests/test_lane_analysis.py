@@ -13,16 +13,20 @@ from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
 from mythril_tpu.orchestration.mythril_disassembler import (
     MythrilDisassembler,
 )
-from mythril_tpu.support.support_args import args as global_args
 
 INPUTS = Path("/root/reference/tests/testdata/inputs")
 
 # fixtures whose module sets leave the device free to fork (no JUMPI
 # hook): EtherThief (post CALL/STATICCALL), AccidentallyKillable
-# (pre SELFDESTRUCT), ArbitraryStorage (pre SSTORE)
+# (pre SELFDESTRUCT), ArbitraryStorage (pre SSTORE). expect_device:
+# whether the deployed runtime code is fully concrete (a constructor
+# that assembles partially-symbolic runtime bytes keeps the analysis
+# host-side — code_to_bytes returns None — which is correct fallback,
+# but makes the parity comparison vacuous as a device test)
 CASES = [
-    ("flag_array.sol.o", "EtherThief", 1, 1),
-    ("symbolic_exec_bytecode.sol.o", "AccidentallyKillable", 1, 1),
+    ("flag_array.sol.o", "EtherThief", 1, 1, True),
+    ("symbolic_exec_bytecode.sol.o", "AccidentallyKillable", 1, 1,
+     False),
 ]
 
 
@@ -30,6 +34,9 @@ def _analyze(file_name, module, tx_count, tpu_lanes):
     disassembler = MythrilDisassembler(eth=None)
     code = (INPUTS / file_name).read_text().strip()
     address, _ = disassembler.load_from_bytecode(code, bin_runtime=False)
+    # tpu_lanes must ride cmd_args (the CLI path): the analyzer
+    # snapshots Args at construction and every fire_lasers restores
+    # that snapshot, so post-hoc global mutation is silently undone
     cmd_args = SimpleNamespace(
         execution_timeout=300,
         max_depth=128,
@@ -45,18 +52,14 @@ def _analyze(file_name, module, tx_count, tpu_lanes):
         custom_modules_directory="",
         solver_log=None,
         transaction_sequences=None,
+        tpu_lanes=tpu_lanes,
     )
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address,
     )
-    old = global_args.tpu_lanes
-    global_args.tpu_lanes = tpu_lanes
-    try:
-        report = analyzer.fire_lasers(
-            modules=[module], transaction_count=tx_count)
-    finally:
-        global_args.tpu_lanes = old
+    report = analyzer.fire_lasers(
+        modules=[module], transaction_count=tx_count)
     return json.loads(report.as_swc_standard_format())
 
 
@@ -73,12 +76,21 @@ def _strip_volatile(obj):
 
 
 @pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
-@pytest.mark.parametrize("file_name,module,tx_count,issue_count", CASES)
-def test_lane_report_parity(file_name, module, tx_count, issue_count):
+@pytest.mark.parametrize(
+    "file_name,module,tx_count,issue_count,expect_device", CASES)
+def test_lane_report_parity(file_name, module, tx_count, issue_count,
+                            expect_device):
+    from mythril_tpu.laser import lane_engine
+
     host = _strip_volatile(_analyze(file_name, module, tx_count,
                                     tpu_lanes=0))
+    lane_engine.RUN_STATS_TOTAL = {}
     lane = _strip_volatile(_analyze(file_name, module, tx_count,
                                     tpu_lanes=64))
+    if expect_device:
+        # the comparison is vacuous unless the device actually explored
+        assert lane_engine.RUN_STATS_TOTAL.get("windows", 0) > 0, \
+            "lane run fell back to the host engine"
     assert host == lane, (
         f"report divergence with lane engine on {file_name}:\n"
         f"host: {json.dumps(host, indent=1)}\n"
@@ -109,19 +121,20 @@ def test_arbitrary_write_symbolic_key_device_parity():
             parallel_solving=False, call_depth_limit=3,
             disable_dependency_pruning=False,
             custom_modules_directory="", solver_log=None,
-            transaction_sequences=None,
+            transaction_sequences=None, tpu_lanes=lanes,
         )
         analyzer = MythrilAnalyzer(
             disassembler=disassembler, cmd_args=cmd_args,
             strategy="bfs", address=address,
         )
-        old = global_args.tpu_lanes
-        global_args.tpu_lanes = lanes
-        try:
-            report = analyzer.fire_lasers(
-                modules=["ArbitraryStorage"], transaction_count=1)
-        finally:
-            global_args.tpu_lanes = old
+        from mythril_tpu.laser import lane_engine
+
+        lane_engine.RUN_STATS_TOTAL = {}
+        report = analyzer.fire_lasers(
+            modules=["ArbitraryStorage"], transaction_count=1)
+        if lanes:
+            assert lane_engine.RUN_STATS_TOTAL.get("windows", 0) > 0, \
+                "device never ran"
         reports.append(_strip_volatile(
             json.loads(report.as_swc_standard_format())))
     host, lane = reports
@@ -129,3 +142,35 @@ def test_arbitrary_write_symbolic_key_device_parity():
     assert host[0]["issues"][0]["swcID"].endswith("124")
     assert lane and lane[0]["issues"], "lane must find the write"
     assert len(lane[0]["issues"]) == len(host[0]["issues"])
+
+
+def test_full_analyze_runs_sharded_on_mesh():
+    """Under the auto mesh policy the full analyzer's lane sweep must
+    shard the engine over the virtual 8-device mesh (the multi-device
+    twin of the single-chip driver path) and produce host-identical
+    issues. Asserts the sweep actually built a sharded engine."""
+    import jax
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from mythril_tpu.laser import lane_engine
+
+    built = []
+    orig = lane_engine.LaneEngine.__init__
+
+    def spy(self, *a, **kw):
+        orig(self, *a, **kw)
+        built.append(kw.get("mesh"))
+
+    lane_engine.LaneEngine.__init__ = spy
+    try:
+        host = _strip_volatile(_analyze(
+            "flag_array.sol.o", "EtherThief", 1, tpu_lanes=0))
+        lane = _strip_volatile(_analyze(
+            "flag_array.sol.o", "EtherThief", 1, tpu_lanes=64))
+    finally:
+        lane_engine.LaneEngine.__init__ = orig
+    meshes = [m for m in built if m is not None]
+    assert meshes, "sweep never built a sharded engine"
+    assert meshes[0].devices.size == 8
+    assert host == lane
