@@ -475,6 +475,7 @@ class LaserEVM:
     def exec(self, create=False, track_gas=False
              ) -> Optional[List[GlobalState]]:
         final_states: List[GlobalState] = []
+        self._pi_wave: List[GlobalState] = []
         for hook in self._start_exec_hooks:
             hook()
         from ..support.devices import effective_tpu_lanes
@@ -483,51 +484,70 @@ class LaserEVM:
             self._lane_engine_sweep()
 
         iter_since_sweep = 0
-        for global_state in self.strategy:
-            if create and self._check_create_termination():
-                log.debug("Hit create timeout, returning.")
-                return final_states + [global_state] if track_gas else None
-            if not create and self._check_execution_termination():
-                log.debug("Hit execution timeout, returning.")
-                return final_states + [global_state] if track_gas else None
-            try:
-                new_states, op_code = self.execute_state(global_state)
-            except NotImplementedError:
-                log.debug("Encountered unimplemented instruction")
-                continue
+        try:
+            for global_state in self.strategy:
+                if create and self._check_create_termination():
+                    log.debug("Hit create timeout, returning.")
+                    return final_states + [global_state] \
+                        if track_gas else None
+                if not create and self._check_execution_termination():
+                    log.debug("Hit execution timeout, returning.")
+                    return final_states + [global_state] \
+                        if track_gas else None
+                try:
+                    new_states, op_code = self.execute_state(global_state)
+                except NotImplementedError:
+                    log.debug("Encountered unimplemented instruction")
+                    continue
 
-            if (
-                self.strategy.run_check()
-                and args.pruning_factor
-                and len(new_states) > 1
-                and random.uniform(0, 1) < args.pruning_factor
-            ):
-                from ..models.pruner import prune_feasible_states
+                if (
+                    self.strategy.run_check()
+                    and args.pruning_factor
+                    and len(new_states) > 1
+                    and random.uniform(0, 1) < args.pruning_factor
+                ):
+                    from ..models.pruner import prune_feasible_states
 
-                new_states = prune_feasible_states(new_states)
-            self.manage_cfg(op_code, new_states)
-            # spill/refill: mid-path states that became device-seedable
-            # again (host executed past their park site) re-enter the
-            # lane engine periodically
-            iter_since_sweep += 1
-            if (
-                args.tpu_lanes
-                and not create
-                and not track_gas
-                and iter_since_sweep >= 512
-                and len(self.work_list) >= 32
-            ):
-                iter_since_sweep = 0
-                self._lane_engine_sweep(min_batch=32)
-            if new_states:
-                self.work_list += new_states
-            elif track_gas:
-                final_states.append(global_state)
-            self.total_states += len(new_states)
+                    new_states = prune_feasible_states(new_states)
+                self.manage_cfg(op_code, new_states)
+                # spill/refill: mid-path states that became device-
+                # seedable again (host executed past their park site)
+                # re-enter the lane engine periodically
+                iter_since_sweep += 1
+                if (
+                    args.tpu_lanes
+                    and not create
+                    and not track_gas
+                    and iter_since_sweep >= 512
+                    and len(self.work_list) >= 32
+                ):
+                    iter_since_sweep = 0
+                    self._lane_engine_sweep(min_batch=32)
+                if new_states:
+                    self.work_list += new_states
+                elif track_gas:
+                    final_states.append(global_state)
+                self.total_states += len(new_states)
+        finally:
+            # cross-state PotentialIssue wave: every end state's
+            # candidates screen in ONE interval batch (device-sized
+            # where per-state discharge saw only a handful), then the
+            # survivors solve as before. Runs on every exit path —
+            # timeouts still discharge what was collected.
+            self._discharge_pi_wave()
 
         for hook in self._stop_exec_hooks:
             hook()
         return final_states if track_gas else None
+
+    def _discharge_pi_wave(self) -> None:
+        states = getattr(self, "_pi_wave", None)
+        if not states:
+            return
+        self._pi_wave = []
+        from ..analysis.potential_issues import discharge_wave
+
+        discharge_wave(states)
 
     def execute_state(
         self, global_state: GlobalState
@@ -625,11 +645,16 @@ class LaserEVM:
                     )
                     or transaction.return_data
                 ) and not end_signal.revert:
-                    from ..analysis.potential_issues import (
-                        check_potential_issues,
-                    )
-
-                    check_potential_issues(global_state)
+                    # defer the PotentialIssue discharge to the end of
+                    # this exec round: the cross-state wave screens ALL
+                    # end states' candidates in one interval batch
+                    # (device-sized), where per-state discharge sees
+                    # only a handful at a time. Bounded: a long round
+                    # discharges every 256 end states rather than
+                    # retaining them all until the finally block
+                    self._pi_wave.append(global_state)
+                    if len(self._pi_wave) >= 256:
+                        self._discharge_pi_wave()
                     end_signal.global_state.world_state.node = (
                         global_state.node
                     )

@@ -16,8 +16,30 @@ from ..support.support_args import args
 
 log = logging.getLogger(__name__)
 
+
+def _all_constraints(constraints):
+    """Constraints + the run's keccak axioms (get_all_constraints) —
+    the axioms confine hash terms to high intervals, which is what lets
+    the screen refute `hash == small-constant` probes. Plain lists
+    (tests, pre-built sets) pass through."""
+    getter = getattr(constraints, "get_all_constraints", None)
+    return getter() if getter is not None else list(constraints)
+
 # below this many states the host loop beats device dispatch overhead
 DEVICE_BATCH_THRESHOLD = 8
+# over a tunneled link every dispatch pays network latency AND the
+# interval kernel jit-specializes per constraint-DAG shape, so a cold
+# wave costs tens of seconds (measured: an 18-item wave spent 50 s in
+# one tunnel compile). Screening a wave host-side costs ~0.5 ms/item —
+# the device only wins there at corpus/scale batch sizes
+DEVICE_BATCH_THRESHOLD_TUNNELED = 4096
+
+
+def _device_threshold() -> int:
+    from ..support.devices import tunneled_backend
+
+    return (DEVICE_BATCH_THRESHOLD_TUNNELED if tunneled_backend()
+            else DEVICE_BATCH_THRESHOLD)
 
 # bounded backoff instead of a permanent latch: one transient device
 # hiccup must not silently degrade every later contract in a corpus run
@@ -64,7 +86,7 @@ def prefilter_world_states(open_states: List) -> List:
 
     if (
         effective_tpu_lanes()
-        and len(open_states) >= DEVICE_BATCH_THRESHOLD
+        and len(open_states) >= _device_threshold()
         and _device_should_try()
     ):
         try:
@@ -80,7 +102,8 @@ def prefilter_world_states(open_states: List) -> List:
     dropped = 0
     for ws in open_states:
         try:
-            infeasible = state_infeasible(list(ws.constraints))
+            infeasible = state_infeasible(
+                list(_all_constraints(ws.constraints)))
         except Exception as e:
             log.debug("interval screening failed: %s", e)
             infeasible = False
@@ -104,7 +127,7 @@ def _screen_interval(items: List, get_constraints) -> List:
     out = None
     if (
         effective_tpu_lanes()
-        and len(items) >= DEVICE_BATCH_THRESHOLD
+        and len(items) >= _device_threshold()
         and _device_should_try()
     ):
         try:
@@ -148,7 +171,8 @@ def prune_feasible_states(states: List) -> List:
     if not states:
         return states
     survivors = _screen_interval(
-        states, lambda s: s.world_state.constraints)
+        states,
+        lambda s: _all_constraints(s.world_state.constraints))
     return [
         s for s in survivors
         if s.world_state.constraints.is_possible()
@@ -159,7 +183,8 @@ def _prefilter_device(open_states: List) -> List:
     from ..ops.intervals import prefilter_feasible
 
     keep = prefilter_feasible(
-        [[c.raw for c in ws.constraints] for ws in open_states]
+        [[c.raw for c in _all_constraints(ws.constraints)]
+         for ws in open_states]
     )
     out = [ws for ws, k in zip(open_states, keep) if k]
     dropped = len(open_states) - len(out)

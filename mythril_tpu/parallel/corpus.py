@@ -75,12 +75,25 @@ def default_analyze(path: str, timeout: int = 60,
                     tpu_lanes: int = 0) -> dict:
     """One contract end to end with the full default detector set.
 
-    MTPU_ANALYZE_DELAY (seconds, test support): extra sleep per
-    contract, simulating per-host wall latency (solver waits, device
-    round trips) on test boxes where every rank shares one CPU —
-    scheduling properties like work-stealing makespan are only
-    observable when work is not purely CPU-bound."""
-    delay = float(os.environ.get("MTPU_ANALYZE_DELAY", "0") or 0)
+    MTPU_ANALYZE_DELAY (test support): extra sleep per contract,
+    simulating per-host wall latency (solver waits, device round
+    trips) on test boxes where every rank shares one CPU — scheduling
+    properties like work-stealing makespan are only observable when
+    work is not purely CPU-bound. Either uniform seconds ("1.5") or
+    per-contract-name substring rules ("metacoin=4.0,nonascii=0.2"),
+    so rigged corpora keep their weight imbalance however fast the
+    underlying analysis gets."""
+    spec = os.environ.get("MTPU_ANALYZE_DELAY", "0") or "0"
+    delay = 0.0
+    if "=" in spec:
+        name = Path(path).name
+        for rule in spec.split(","):
+            pat, _, secs = rule.partition("=")
+            if pat and pat in name:
+                delay = float(secs)
+                break
+    else:
+        delay = float(spec)
     if delay:
         time.sleep(delay)
 
@@ -229,6 +242,10 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
 
 
 def main(argv=None) -> int:
+    if os.environ.get("MTPU_CORPUS_LOG"):
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(message)s")
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--coordinator", default=None,
                         help="HOST:PORT of rank 0 (omit = standalone)")

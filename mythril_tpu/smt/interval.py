@@ -266,20 +266,50 @@ def must_be_true(t: "T.Term", memo=None) -> bool:
 # pass sees them. Mirrored on device by mythril_tpu/ops/intervals.py.
 
 
+#: per-assertion bound contributions, memoized by tid: a constraint
+#: term's syntactic var-vs-const facts are state-independent, and wave
+#: screening evaluates the SAME shared constraint objects across
+#: thousands of sibling systems — extracting each term's facts once
+#: turns the per-system seed pass into a cheap interval merge.
+_CONTRIB_CACHE: Dict[int, tuple] = {}
+
+
+def _term_contributions(t: "T.Term") -> tuple:
+    cached = _CONTRIB_CACHE.get(t.tid)
+    if cached is None:
+        facts: list = []
+
+        def note(var, lo, hi):
+            facts.append((var, lo, hi))
+
+        _visit_bounds(t, note, True)
+        cached = tuple(facts)
+        if len(_CONTRIB_CACHE) > 1 << 20:
+            _CONTRIB_CACHE.clear()
+        _CONTRIB_CACHE[t.tid] = cached
+    return cached
+
+
 def extract_bounds(assertions) -> Dict[int, Tuple["T.Term", int, int]]:
     """{var_tid: (var_term, lo, hi)} from syntactic var-vs-const facts.
 
     An empty range (lo > hi) marks the whole system infeasible."""
     bounds: Dict[int, Tuple["T.Term", int, int]] = {}
+    for t in assertions:
+        for var, lo, hi in _term_contributions(getattr(t, "raw", t)):
+            old = bounds.get(var.tid)
+            if old is None:
+                w = var.width if isinstance(var.width, int) else 256
+                olo, ohi = 0, (1 << w) - 1
+            else:
+                _, olo, ohi = old
+            bounds[var.tid] = (var, max(lo, olo), min(hi, ohi))
+    return bounds
 
-    def note(var, lo, hi):
-        old = bounds.get(var.tid)
-        if old is None:
-            w = var.width if isinstance(var.width, int) else 256
-            olo, ohi = 0, (1 << w) - 1
-        else:
-            _, olo, ohi = old
-        bounds[var.tid] = (var, max(lo, olo), min(hi, ohi))
+
+def _visit_bounds(root, note, positive=True):
+    """Walk one assertion for syntactic atom-vs-const facts, calling
+    note(atom, lo, hi) for each."""
 
     def visit(t, positive=True):
         op = t.op
@@ -298,7 +328,13 @@ def extract_bounds(assertions) -> Dict[int, Tuple["T.Term", int, int]]:
         if op not in (T.ULT, T.ULE, T.EQ):
             return
         a, b = t.args
-        av, bv = a.op == T.BV_VAR, b.op == T.BV_VAR
+        # SELECT/APPLY atoms bound like variables (the evaluator already
+        # treats them as opaque memo-keyed atoms): this is what lets the
+        # keccak manager's interval axioms — ULE(lo, keccak(x)),
+        # ULT(keccak(x), hi), keccak(x) & 63 == 0 — refute detector
+        # probes such as `keccak(x) == small-constant` without a solver
+        _atom = (T.BV_VAR, T.SELECT, T.APPLY)
+        av, bv = a.op in _atom, b.op in _atom
         ac, bc = a.op == T.BV_CONST, b.op == T.BV_CONST
         w = a.width if isinstance(a.width, int) else 0
         if not w:
@@ -345,9 +381,7 @@ def extract_bounds(assertions) -> Dict[int, Tuple["T.Term", int, int]]:
                 elif ac and bv:
                     note(b, 0, a.val - 1)
 
-    for t in assertions:
-        visit(getattr(t, "raw", t), True)
-    return bounds
+    visit(root, positive)
 
 
 def state_infeasible(assertions) -> bool:

@@ -17,10 +17,13 @@ import pytest
 
 INPUTS = Path("/root/reference/tests/testdata/inputs")
 
-# shards are round-robin over SORTED names: heavy copies (metacoin,
-# ~1.3 s each) at even sort positions all land on rank 0, featherweight
-# copies (nonascii, ~0.1 s) at odd positions on rank 1 — a deliberately
-# imbalanced corpus
+# shards are round-robin over SORTED names: heavy copies at even sort
+# positions all land on rank 0, featherweight copies at odd positions
+# on rank 1 — a deliberately imbalanced corpus. The weight gap comes
+# from per-name MTPU_ANALYZE_DELAY rules (not from analysis speed,
+# which engine improvements keep shrinking): the heavy shard's wall is
+# ~4x the light shard's plus any process-startup skew, so the light
+# rank always drains first and the steal must fire
 HEAVY, LIGHT = "metacoin.sol.o", "nonascii.sol.o"
 
 
@@ -49,9 +52,10 @@ def _run(tmp_path, files, out_name, steal):
         env.pop("XLA_FLAGS", None)
         # the test box shares ONE cpu between both ranks, so pure
         # cpu-bound work cannot be sped up by redistribution; the
-        # per-contract delay models the per-host latency (solver
-        # waits, device round trips) real deployments have
-        env["MTPU_ANALYZE_DELAY"] = "1.5"
+        # per-name delay rules model the per-host latency (solver
+        # waits, device round trips) real deployments have, and keep
+        # the rig's weight imbalance independent of analysis speed
+        env["MTPU_ANALYZE_DELAY"] = "metacoin=4.0,nonascii=0.2"
         cmd = [sys.executable, "-m", "mythril_tpu.parallel.corpus",
                "--coordinator", coordinator,
                "--num-processes", "2", "--process-id", str(rank),
