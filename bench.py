@@ -26,6 +26,14 @@ import numpy as np
 TRIALS = max(1, int(os.environ.get("BENCH_TRIALS", "3")))
 
 
+def _fixture_inputs() -> str:
+    """Vendored bytecode-fixture corpus (tests/fixture_paths is the
+    single resolver; falls back to a reference checkout)."""
+    from tests.fixture_paths import INPUTS
+
+    return str(INPUTS)
+
+
 def _spread(xs):
     return {"median": round(statistics.median(xs), 2),
             "min": round(min(xs), 2), "max": round(max(xs), 2),
@@ -313,7 +321,7 @@ def bench_configs():
     from mythril_tpu.laser import lane_engine
 
     inputs = Path(os.environ.get(
-        "BENCH_FIXTURES", "/root/reference/tests/testdata/inputs"))
+        "BENCH_FIXTURES", _fixture_inputs()))
     out = []
     if not inputs.exists():
         return out  # no fixture corpus on this machine: skip configs
@@ -467,7 +475,7 @@ def bench_config4(timeout=60, lanes=4096):
     import bench_corpus
 
     inputs = Path(os.environ.get(
-        "BENCH_FIXTURES", "/root/reference/tests/testdata/inputs"))
+        "BENCH_FIXTURES", _fixture_inputs()))
     if not inputs.exists():
         return None
     fixtures = sorted(inputs.glob("*.sol.o"))

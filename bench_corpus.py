@@ -12,7 +12,10 @@ import sys
 import time
 from pathlib import Path
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+import sys as _sys
+
+_sys.path.insert(0, str(Path(__file__).resolve().parent))
+from tests.fixture_paths import INPUTS  # noqa: E402
 
 # The corpus is mixed: these four fixtures are CREATION bytecode (the
 # reference's analysis_tests run them without --bin-runtime; their

@@ -17,7 +17,8 @@ import sys
 import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-INPUTS = pathlib.Path("/root/reference/tests/testdata/inputs")
+sys.path.insert(0, str(REPO))
+from tests.fixture_paths import INPUTS  # noqa: E402
 CREATION_FIXTURES = {
     "flag_array.sol.o",
     "exceptions_0.8.0.sol.o",

@@ -1,0 +1,29 @@
+"""Single resolver for the vendored test corpora (tests/fixtures/ —
+see its README): every suite reads fixture DATA through these paths,
+so the tests run with no reference checkout mounted. A missing
+vendored directory (e.g. a sparse checkout) falls back to the
+reference location the data was vendored from."""
+
+from pathlib import Path
+
+_FIXTURES = Path(__file__).resolve().parent / "fixtures"
+_REFERENCE = Path("/root/reference/tests")
+
+
+def _resolve(vendored: Path, reference: Path) -> Path:
+    return vendored if vendored.exists() else reference
+
+
+#: solc-compiled bytecode fixtures (*.sol.o)
+INPUTS = _resolve(_FIXTURES / "testdata" / "inputs",
+                  _REFERENCE / "testdata" / "inputs")
+#: solidity sources for the solc front-end tests
+INPUT_CONTRACTS = _resolve(_FIXTURES / "testdata" / "input_contracts",
+                           _REFERENCE / "testdata" / "input_contracts")
+#: expected easm disassembly goldens
+OUTPUTS_EXPECTED = _resolve(
+    _FIXTURES / "testdata" / "outputs_expected",
+    _REFERENCE / "testdata" / "outputs_expected")
+#: official Ethereum VMTests JSON conformance corpus
+VMTESTS = _resolve(_FIXTURES / "evm_testsuite" / "VMTests",
+                   _REFERENCE / "laser" / "evm_testsuite" / "VMTests")

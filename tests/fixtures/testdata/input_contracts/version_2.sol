@@ -1,0 +1,9 @@
+
+// VERSION: pragma solidity ^0.7.0;
+
+contract Test {
+    uint256 input;
+    function add(uint256 a, uint256 b) public {
+        input = a + b;
+    }
+}

@@ -14,7 +14,7 @@ from mythril_tpu.orchestration.mythril_disassembler import (
     MythrilDisassembler,
 )
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+from .fixture_paths import INPUTS
 
 # (fixture, module, tx_count, expected issue count, issue#, step#,
 #  expected exact exploit calldata or None)

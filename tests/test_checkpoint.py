@@ -16,7 +16,9 @@ from mythril_tpu.orchestration.mythril_disassembler import (
 )
 from mythril_tpu.support.analysis_args import make_cmd_args
 
-FIXTURE = Path("/root/reference/tests/testdata/inputs/metacoin.sol.o")
+from .fixture_paths import INPUTS
+
+FIXTURE = INPUTS / "metacoin.sol.o"
 
 pytestmark = pytest.mark.skipif(
     not FIXTURE.exists(), reason="fixture corpus not present")

@@ -11,9 +11,11 @@ from pathlib import Path
 
 import pytest
 
+from .fixture_paths import INPUTS
+
 REPO = Path(__file__).resolve().parent.parent
 SUICIDE_O = Path(
-    "/root/reference/tests/testdata/inputs/suicide.sol.o")
+    str(INPUTS / "suicide.sol.o"))
 
 ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
 

@@ -16,7 +16,7 @@ import pytest
 
 from mythril_tpu.parallel.corpus import shard_corpus
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+from .fixture_paths import INPUTS
 FIXTURES = ["suicide.sol.o", "origin.sol.o", "returnvalue.sol.o",
             "nonascii.sol.o"]
 

@@ -15,7 +15,7 @@ from pathlib import Path
 
 import pytest
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+from .fixture_paths import INPUTS
 
 # shards are round-robin over SORTED names: heavy copies at even sort
 # positions all land on rank 0, featherweight copies at odd positions

@@ -21,7 +21,7 @@ from mythril_tpu.orchestration.mythril_disassembler import (
 )
 from mythril_tpu.support.support_args import args as global_args
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+from .fixture_paths import INPUTS
 
 # small fixtures that exercise origin/integer/exceptions adapters
 FIXTURES = ["origin.sol.o", "underflow.sol.o", "exceptions.sol.o"]

@@ -14,7 +14,7 @@ from mythril_tpu.orchestration.mythril_disassembler import (
     MythrilDisassembler,
 )
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+from .fixture_paths import INPUTS
 
 # fixtures whose module sets leave the device free to fork (no JUMPI
 # hook): EtherThief (post CALL/STATICCALL), AccidentallyKillable

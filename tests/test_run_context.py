@@ -6,7 +6,7 @@ reference mythril/support/support_args.py:5-43)."""
 
 from pathlib import Path
 
-INPUTS = Path("/root/reference/tests/testdata/inputs")
+from .fixture_paths import INPUTS
 
 
 def _make_analyzer(fixture: str, timeout: int = 60):

@@ -25,8 +25,9 @@ import pytest
 from mythril_tpu.solidity.soliditycontract import SolidityContract
 from mythril_tpu.solidity.util import SolcError, get_solc_json
 
-REF = Path("/root/reference/tests/testdata")
-SOURCE_FILE = REF / "input_contracts" / "suicide.sol"
+from .fixture_paths import INPUT_CONTRACTS
+
+SOURCE_FILE = INPUT_CONTRACTS / "suicide.sol"
 REPO = Path(__file__).resolve().parent.parent
 
 

@@ -17,9 +17,10 @@ import mythril_tpu.solidity.soliditycontract as sc_mod
 from mythril_tpu.disassembler.disassembly import Disassembly
 from mythril_tpu.solidity.soliditycontract import SolidityContract
 
-REF = Path("/root/reference/tests/testdata")
-SOURCE_FILE = REF / "input_contracts" / "suicide.sol"
-RUNTIME_FILE = REF / "inputs" / "suicide.sol.o"
+from .fixture_paths import INPUT_CONTRACTS, INPUTS
+
+SOURCE_FILE = INPUT_CONTRACTS / "suicide.sol"
+RUNTIME_FILE = INPUTS / "suicide.sol.o"
 
 
 def _creation_wrapper(runtime_hex: str) -> str:

@@ -23,7 +23,7 @@ from mythril_tpu.laser.transaction.concolic import execute_message_call
 from mythril_tpu.smt import Expression, symbol_factory
 from mythril_tpu.support.support_args import args
 
-VMTESTS_DIR = Path("/root/reference/tests/laser/evm_testsuite/VMTests")
+from .fixture_paths import VMTESTS as VMTESTS_DIR  # noqa: E402
 
 TEST_TYPES = [
     "vmArithmeticTest",
