@@ -320,8 +320,8 @@ def bench_configs():
 
     from mythril_tpu.laser import lane_engine
 
-    inputs = Path(os.environ.get(
-        "BENCH_FIXTURES", _fixture_inputs()))
+    inputs = Path(os.environ.get("BENCH_FIXTURES")
+                  or _fixture_inputs())
     out = []
     if not inputs.exists():
         return out  # no fixture corpus on this machine: skip configs
@@ -474,8 +474,8 @@ def bench_config4(timeout=60, lanes=4096):
 
     import bench_corpus
 
-    inputs = Path(os.environ.get(
-        "BENCH_FIXTURES", _fixture_inputs()))
+    inputs = Path(os.environ.get("BENCH_FIXTURES")
+                  or _fixture_inputs())
     if not inputs.exists():
         return None
     fixtures = sorted(inputs.glob("*.sol.o"))

@@ -12,9 +12,7 @@ import sys
 import time
 from pathlib import Path
 
-import sys as _sys
-
-_sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 from tests.fixture_paths import INPUTS  # noqa: E402
 
 # The corpus is mixed: these four fixtures are CREATION bytecode (the

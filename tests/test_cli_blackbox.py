@@ -14,8 +14,7 @@ import pytest
 from .fixture_paths import INPUTS
 
 REPO = Path(__file__).resolve().parent.parent
-SUICIDE_O = Path(
-    str(INPUTS / "suicide.sol.o"))
+SUICIDE_O = INPUTS / "suicide.sol.o"
 
 ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
 

@@ -23,7 +23,8 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-REF = Path("/root/reference/tests/testdata")
+sys.path.insert(0, str(REPO))
+from tests.fixture_paths import INPUTS as REF_INPUTS  # noqa: E402
 
 VERSION = (
     "solc, the solidity compiler commandline interface\n"
@@ -45,7 +46,7 @@ def _compile_suicide(src_path: str, source: str) -> dict:
     from mythril_tpu.disassembler.disassembly import Disassembly
 
     runtime_hex = (
-        (REF / "inputs" / "suicide.sol.o").read_text().strip()
+        (REF_INPUTS / "suicide.sol.o").read_text().strip()
         .replace("0x", "")
     )
     disas = Disassembly(runtime_hex)
