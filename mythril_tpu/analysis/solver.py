@@ -112,7 +112,8 @@ def _replace_with_actual_sha(
     real keccak of the recovered preimage."""
     concrete_hashes = keccak_function_manager.get_concrete_hash_data(model)
     for tx in concrete_transactions:
-        if keccak_function_manager.hash_matcher not in tx["input"]:
+        if not keccak_function_manager.might_contain_placeholder(
+                tx["input"]):
             continue
         if code is not None and code.bytecode in tx["input"]:
             s_index = len(code.bytecode) + 2
@@ -121,8 +122,9 @@ def _replace_with_actual_sha(
         for i in range(s_index, len(tx["input"])):
             data_slice = tx["input"][i : i + 64]
             if (
-                keccak_function_manager.hash_matcher not in data_slice
-                or len(data_slice) != 64
+                len(data_slice) != 64
+                or not keccak_function_manager
+                .might_contain_placeholder(data_slice)
             ):
                 continue
             find_input = symbol_factory.BitVecVal(
@@ -130,9 +132,9 @@ def _replace_with_actual_sha(
             )
             input_ = None
             for size in concrete_hashes:
-                _, inverse = keccak_function_manager.store_function[size]
                 if find_input.value not in concrete_hashes[size]:
                     continue
+                inverse = keccak_function_manager.inverse_for(size)
                 inv_value = model.eval(
                     inverse(find_input), model_completion=True
                 )

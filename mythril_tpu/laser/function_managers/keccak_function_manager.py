@@ -1,18 +1,34 @@
-"""Keccak modeling: per-width uninterpreted functions with inverse axioms and
-disjoint output intervals (capability parity:
-mythril/laser/ethereum/function_managers/keccak_function_manager.py:25-180;
-scheme from the VerX paper).
+"""Keccak modeling for the symbolic engine.
 
-Properties encoded per symbolic input x of width w:
-- inverse(keccak_w(x)) == x  (injectivity);
-- keccak_w(x) lies in a per-width disjoint interval of the 256-bit space,
-  and is ≡ 0 mod 64 (spreads hashes for mapping/array slots);
-- or keccak_w(x) equals a known concrete hash when x equals that concrete
-  input.
-Concrete inputs are hashed for real with the native keccak.
+Capability parity with the reference's VerX-style scheme
+(mythril/laser/ethereum/function_managers/keccak_function_manager.py:
+25-180) — uninterpreted functions with inverse axioms and disjoint
+output ranges — re-architected around this build's term DAG:
+
+- Every distinct input WIDTH owns one `_WidthModel` record: the
+  `kec_w`/`unkec_w` uninterpreted-function pair plus one SLAB of the
+  placeholder region. The placeholder region is the top `2^228` values
+  of the 256-bit space: every member's hex rendering starts with seven
+  'f' digits (28 set bits), which is what report-time back-substitution
+  scans calldata for (analysis/solver.py), and what the interval
+  prefilter uses to refute `hash == small-constant` detector probes
+  without a solver (smt/interval.py treats APPLY atoms as boundable).
+- Slabs are `2^212` wide and handed out in width-arrival order, so
+  placeholder hashes of different input widths can never collide, and
+  hashes are pinned ≡ 0 mod 64 inside their slab (mapping/array slot
+  spreading, as in VerX).
+- Per-input axioms are built once and cached as hash-consed terms
+  (keyed by the input's term id and the count of same-width concrete
+  hashes, which widen the axiom's escape disjunct); `axioms()` is a
+  cheap conjunction of cached terms rather than a rebuild.
+
+Concrete inputs are hashed for real with the native C++ keccak.
+State is per-run: the module-level handle is a SwappableProxy the run
+context exchanges (support/run_context.py).
 """
 
 import logging
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ...smt import (
@@ -28,127 +44,193 @@ from ...smt import (
 )
 from ...support.support_utils import sha3
 
-TOTAL_PARTS = 10**40
-PART = (2**256 - 1) // TOTAL_PARTS
-INTERVAL_DIFFERENCE = 10**30
 log = logging.getLogger(__name__)
+
+#: the placeholder region: values whose top PREFIX_BITS bits are all
+#: set — chosen so every placeholder's 64-hex-digit rendering starts
+#: with PREFIX_HEX, a pattern cheap to scan calldata for and (at
+#: 2^-28 per real hash) rare enough to make false positives moot
+PREFIX_BITS = 28
+PREFIX_HEX = "f" * (PREFIX_BITS // 4)
+REGION_LO = ((1 << PREFIX_BITS) - 1) << (256 - PREFIX_BITS)
+
+#: one slab per input width, carved out of the region in arrival
+#: order; 2^212-wide slabs leave room for 65536 distinct widths
+SLAB_BITS = 212
+SLAB = 1 << SLAB_BITS
+
+#: hashes are pinned to multiples of 64 within their slab: consecutive
+#: storage cells derived from a hash (array data regions) then stay
+#: inside one placeholder neighbourhood (VerX's spreading trick)
+ALIGN = 64
+
+
+@dataclass
+class _WidthModel:
+    """Everything the scheme tracks for one input width."""
+
+    uf: Function
+    inverse: Function
+    slab_lo: int
+    slab_hi: int
+    symbolic_inputs: List[BitVec] = field(default_factory=list)
+    results: List[BitVec] = field(default_factory=list)
 
 
 class KeccakFunctionManager:
-    hash_matcher = "fffffff"  # usual prefix of interval-placeholder hashes
+    #: distinctive hex prefix of every interval-placeholder hash (the
+    #: report back-substitution's fast scan key)
+    hash_matcher = PREFIX_HEX
 
     def __init__(self):
-        self.store_function: Dict[int, Tuple[Function, Function]] = {}
-        self.interval_hook_for_size: Dict[int, int] = {}
-        self._index_counter = TOTAL_PARTS - 34534
-        self.hash_result_store: Dict[int, List[BitVec]] = {}
-        self.quick_inverse: Dict[BitVec, BitVec] = {}  # for VM test replay
+        self._widths: Dict[int, _WidthModel] = {}
+        self._next_slab = 0
+        #: concrete input term -> its real keccak (axioms link them to
+        #: the UF so symbolic inputs may equal concrete ones)
         self.concrete_hashes: Dict[BitVec, BitVec] = {}
-        self.symbolic_inputs: Dict[int, List[BitVec]] = {}
+        #: real hash -> preimage, for the VMTests concrete replay path
+        self.quick_inverse: Dict[BitVec, BitVec] = {}
+        #: per-width (input term, hash) pairs, appended by
+        #: create_keccak — the axiom cache keys on their count, so the
+        #: cache-hit path is two dict lookups, no scans
+        self._concrete_by_width: Dict[int, List[Tuple[BitVec, BitVec]]] \
+            = {}
+        #: (input tid, same-width concrete count) -> cached axiom term
+        self._axiom_cache: Dict[Tuple[int, int], Bool] = {}
 
     def reset(self):
         self.__init__()
 
-    @staticmethod
-    def find_concrete_keccak(data: BitVec) -> BitVec:
-        return symbol_factory.BitVecVal(
-            int.from_bytes(
-                sha3(data.value.to_bytes(data.size() // 8, byteorder="big")),
-                "big",
-            ),
-            256,
-        )
+    # -- model records ------------------------------------------------------
+
+    def _model(self, width: int) -> _WidthModel:
+        model = self._widths.get(width)
+        if model is None:
+            if self._next_slab >= 1 << (256 - PREFIX_BITS - SLAB_BITS):
+                raise RuntimeError(
+                    "placeholder region exhausted: more than "
+                    f"{1 << (256 - PREFIX_BITS - SLAB_BITS)} distinct "
+                    "keccak input widths in one run")
+            lo = REGION_LO + self._next_slab * SLAB
+            self._next_slab += 1
+            model = _WidthModel(
+                uf=Function(f"kec{width}", [width], 256),
+                inverse=Function(f"unkec{width}", [256], width),
+                slab_lo=lo,
+                slab_hi=lo + SLAB,
+            )
+            self._widths[width] = model
+        return model
 
     def get_function(self, length: int) -> Tuple[Function, Function]:
-        try:
-            func, inverse = self.store_function[length]
-        except KeyError:
-            func = Function("keccak256_{}".format(length), [length], 256)
-            inverse = Function("keccak256_{}-1".format(length), [256], length)
-            self.store_function[length] = (func, inverse)
-            self.hash_result_store[length] = []
-        return func, inverse
+        """(keccak UF, inverse UF) for an input width."""
+        model = self._model(length)
+        return model.uf, model.inverse
+
+    def inverse_for(self, length: int) -> Function:
+        return self._model(length).inverse
+
+    # -- placeholder region -------------------------------------------------
+
+    @staticmethod
+    def value_in_placeholder_region(value: int) -> bool:
+        return value >= REGION_LO
+
+    @classmethod
+    def might_contain_placeholder(cls, hex_text: str) -> bool:
+        """Fast scan gate: can this hex blob hold a placeholder hash?"""
+        return cls.hash_matcher in hex_text
+
+    # -- hashing ------------------------------------------------------------
+
+    @staticmethod
+    def find_concrete_keccak(data: BitVec) -> BitVec:
+        raw = data.value.to_bytes(data.size() // 8, byteorder="big")
+        return symbol_factory.BitVecVal(
+            int.from_bytes(sha3(raw), "big"), 256)
 
     @staticmethod
     def get_empty_keccak_hash() -> BitVec:
-        val = int.from_bytes(sha3(b""), "big")
-        return symbol_factory.BitVecVal(val, 256)
+        return symbol_factory.BitVecVal(
+            int.from_bytes(sha3(b""), "big"), 256)
 
     def create_keccak(self, data: BitVec) -> BitVec:
-        length = data.size()
-        func, _ = self.get_function(length)
+        """The engine's SHA3 result for `data`: the real hash when the
+        input is concrete, the width's UF applied to it otherwise."""
+        model = self._model(data.size())
+        if not data.symbolic:
+            result = self.find_concrete_keccak(data)
+            if data not in self.concrete_hashes:
+                self._concrete_by_width.setdefault(
+                    data.size(), []).append((data, result))
+            self.concrete_hashes[data] = result
+            return result
+        model.symbolic_inputs.append(data)
+        result = model.uf(data)
+        model.results.append(result)
+        return result
 
-        if data.symbolic is False:
-            concrete_hash = self.find_concrete_keccak(data)
-            self.concrete_hashes[data] = concrete_hash
-            return concrete_hash
+    # -- axioms -------------------------------------------------------------
 
-        self.symbolic_inputs.setdefault(length, []).append(data)
-        self.hash_result_store[length].append(func(data))
-        return func(data)
+    def _axiom_for(self, data: BitVec) -> Bool:
+        """inverse(kec(x)) == x, and kec(x) either lives 64-aligned in
+        the width's slab or coincides with a known concrete hash whose
+        input x equals. Cached per (input, concrete-escape count)."""
+        width = data.size()
+        model = self._widths[width]
+        same_width = self._concrete_by_width.get(width, ())
+        key = (data.raw.tid, len(same_width))
+        cached = self._axiom_cache.get(key)
+        if cached is not None:
+            return cached
+        h = model.uf(data)
+        in_slab = And(
+            ULE(symbol_factory.BitVecVal(model.slab_lo, 256), h),
+            ULT(h, symbol_factory.BitVecVal(model.slab_hi, 256)),
+            URem(h, symbol_factory.BitVecVal(ALIGN, 256))
+            == symbol_factory.BitVecVal(0, 256),
+        )
+        escape = symbol_factory.Bool(False)
+        for conc_input, conc_hash in same_width:
+            escape = Or(escape,
+                        And(h == conc_hash, data == conc_input))
+        axiom = And(model.inverse(h) == data, Or(in_slab, escape))
+        self._axiom_cache[key] = axiom
+        return axiom
 
     def create_conditions(self) -> Bool:
-        condition = symbol_factory.Bool(True)
-        for inputs_list in self.symbolic_inputs.values():
-            for symbolic_input in inputs_list:
-                condition = And(
-                    condition,
-                    self._create_condition(func_input=symbolic_input),
-                )
-        for concrete_input, concrete_hash in self.concrete_hashes.items():
-            func, inverse = self.get_function(concrete_input.size())
-            condition = And(
-                condition,
-                func(concrete_input) == concrete_hash,
-                inverse(func(concrete_input)) == concrete_input,
-            )
-        return condition
+        """The conjunction of every axiom this run's hashes need —
+        appended to each solver query by Constraints.get_all_constraints
+        (laser/state/constraints.py)."""
+        parts: List[Bool] = []
+        for model in self._widths.values():
+            parts.extend(self._axiom_for(data)
+                         for data in model.symbolic_inputs)
+        for conc_input, conc_hash in self.concrete_hashes.items():
+            uf, inverse = self.get_function(conc_input.size())
+            applied = uf(conc_input)
+            parts.append(And(applied == conc_hash,
+                             inverse(applied) == conc_input))
+        if not parts:
+            return symbol_factory.Bool(True)
+        return And(*parts)
 
-    def get_concrete_hash_data(self, model) -> Dict[int, List[Optional[int]]]:
-        """Concrete hash values under a model, per input width."""
-        concrete_hashes: Dict[int, List[Optional[int]]] = {}
-        for size in self.hash_result_store:
-            concrete_hashes[size] = []
-            for val in self.hash_result_store[size]:
-                eval_ = model.eval(val, model_completion=False)
-                if eval_ is None:
+    # -- model extraction ---------------------------------------------------
+
+    def get_concrete_hash_data(self, model
+                               ) -> Dict[int, List[Optional[int]]]:
+        """Per input width, the model's concrete values for every UF
+        hash result (report back-substitution input)."""
+        out: Dict[int, List[Optional[int]]] = {}
+        for width, wm in self._widths.items():
+            values: List[Optional[int]] = []
+            for result in wm.results:
+                evaluated = model.eval(result, model_completion=False)
+                if evaluated is None or evaluated.value is None:
                     continue
-                concrete_val = eval_.value
-                if concrete_val is not None:
-                    concrete_hashes[size].append(concrete_val)
-        return concrete_hashes
-
-    def _create_condition(self, func_input: BitVec) -> Bool:
-        length = func_input.size()
-        func, inv = self.get_function(length)
-        try:
-            index = self.interval_hook_for_size[length]
-        except KeyError:
-            self.interval_hook_for_size[length] = self._index_counter
-            index = self._index_counter
-            self._index_counter -= INTERVAL_DIFFERENCE
-
-        lower_bound = index * PART
-        upper_bound = lower_bound + PART
-
-        cond = And(
-            inv(func(func_input)) == func_input,
-            ULE(
-                symbol_factory.BitVecVal(lower_bound, 256), func(func_input)
-            ),
-            ULT(
-                func(func_input), symbol_factory.BitVecVal(upper_bound, 256)
-            ),
-            URem(func(func_input), symbol_factory.BitVecVal(64, 256)) == 0,
-        )
-        concrete_cond = symbol_factory.Bool(False)
-        for key, keccak in self.concrete_hashes.items():
-            if key.size() == func_input.size():
-                hash_eq = And(func(func_input) == keccak, key == func_input)
-                concrete_cond = Or(concrete_cond, hash_eq)
-        return And(
-            inv(func(func_input)) == func_input, Or(cond, concrete_cond)
-        )
+                values.append(evaluated.value)
+            out[width] = values
+        return out
 
 
 from ...support.run_context import SwappableProxy  # noqa: E402

@@ -42,7 +42,7 @@ from ..smt import terms as T
 
 log = logging.getLogger(__name__)
 
-VERSION = 2
+VERSION = 3
 
 #: load-time table of saved-tid -> re-interned Term (set around the
 #: payload unpickling; term references resolve through it)
@@ -120,12 +120,13 @@ def _keccak_state() -> Dict[str, Any]:
     from ..laser.function_managers import keccak_function_manager as km
 
     return {
-        "symbolic_inputs": dict(km.symbolic_inputs),
-        "hash_result_store": dict(km.hash_result_store),
+        "widths": {
+            w: {"symbolic_inputs": list(m.symbolic_inputs),
+                "results": list(m.results)}
+            for w, m in km._widths.items()
+        },
         "concrete_hashes": dict(km.concrete_hashes),
         "quick_inverse": dict(km.quick_inverse),
-        "interval_hook_for_size": dict(km.interval_hook_for_size),
-        "index_counter": km._index_counter,
     }
 
 
@@ -215,9 +216,7 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
         tx_counter = payload["tx_counter"]
         keccak = {
             key: payload["keccak"][key]
-            for key in ("symbolic_inputs", "hash_result_store",
-                        "concrete_hashes", "quick_inverse",
-                        "interval_hook_for_size", "index_counter")
+            for key in ("widths", "concrete_hashes", "quick_inverse")
         }
         modules = payload["modules"]
     except Exception as e:
@@ -229,14 +228,19 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
     from ..laser.transaction import tx_id_manager
 
     tx_id_manager._next = tx_counter
-    km.symbolic_inputs.update(keccak["symbolic_inputs"])
-    km.hash_result_store.update(keccak["hash_result_store"])
-    km.concrete_hashes.update(keccak["concrete_hashes"])
+    # width models rebuild in the snapshot's insertion order (pickle
+    # preserves dict order) so each width reclaims the same slab
+    for width, entry in keccak["widths"].items():
+        km.get_function(width)
+        model = km._widths[width]
+        model.symbolic_inputs.extend(entry["symbolic_inputs"])
+        model.results.extend(entry["results"])
+    for data, result in keccak["concrete_hashes"].items():
+        if data not in km.concrete_hashes:
+            km._concrete_by_width.setdefault(
+                data.size(), []).append((data, result))
+        km.concrete_hashes[data] = result
     km.quick_inverse.update(keccak["quick_inverse"])
-    km.interval_hook_for_size.update(keccak["interval_hook_for_size"])
-    km._index_counter = keccak["index_counter"]
-    for size in keccak["hash_result_store"]:
-        km.get_function(size)  # rebuild the Function pairs
     for module in ModuleLoader().get_detection_modules():
         entry = modules.get(type(module).__name__)
         if entry is not None:
