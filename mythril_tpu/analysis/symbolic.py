@@ -218,18 +218,14 @@ class SymExecWrapper:
         # loadable snapshot exists
         resumed = False
         if args.checkpoint_file:
-            from hashlib import sha256
-
             from ..support.checkpoint import (
-                load_checkpoint, save_checkpoint,
+                code_identity, load_checkpoint, save_checkpoint,
             )
 
             path = args.checkpoint_file
             # bind snapshots to the analyzed code: multi-contract runs
             # sharing one checkpoint file must not resume each other
-            code_id = sha256(
-                (contract.creation_code or contract.code or "")
-                .encode()).hexdigest()
+            code_id = code_identity(contract)
 
             def _sink(next_round, open_states, addr):
                 save_checkpoint(

@@ -266,6 +266,13 @@ class LaserEVM:
                 hook()
             if self.checkpoint_sink is not None:
                 self.checkpoint_sink(i + 1, self.open_states, address)
+            # cross-host path-batch migration (parallel/migrate.py):
+            # a drained corpus rank can take half this round's open
+            # states; the bus trims self.open_states in place
+            bus = getattr(args, "migration_bus", None)
+            if bus is not None:
+                bus.on_round_end(self, i + 1, self.transaction_count,
+                                 address)
         self.start_round = 0  # a later sym_exec must not skip rounds
         self.executed_transactions = True
 

@@ -75,6 +75,7 @@ class MythrilAnalyzer:
         args.tpu_lanes = getattr(cmd_args, "tpu_lanes", args.tpu_lanes)
         args.tpu_mesh = getattr(cmd_args, "tpu_mesh", args.tpu_mesh)
         args.checkpoint_file = getattr(cmd_args, "checkpoint", None)
+        args.migration_bus = getattr(cmd_args, "migration_bus", None)
         from ..support.devices import effective_tpu_lanes
 
         effective_tpu_lanes()  # resolve the auto sentinel for this run

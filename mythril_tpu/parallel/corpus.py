@@ -72,7 +72,7 @@ def _barrier(name: str) -> None:
 
 
 def default_analyze(path: str, timeout: int = 60,
-                    tpu_lanes: int = 0) -> dict:
+                    tpu_lanes: int = 0, bus=None) -> dict:
     """One contract end to end with the full default detector set.
 
     MTPU_ANALYZE_DELAY (test support): extra sleep per contract,
@@ -105,18 +105,29 @@ def default_analyze(path: str, timeout: int = 60,
     code = Path(path).read_text().strip()
     address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
     cmd_args = make_cmd_args(execution_timeout=timeout,
-                             tpu_lanes=tpu_lanes)
+                             tpu_lanes=tpu_lanes,
+                             migration_bus=bus)
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address,
     )
+    migrated = 0
+    if bus is not None:
+        bus.begin_contract(path, disassembler.contracts[-1])
     report = analyzer.fire_lasers(modules=None, transaction_count=2)
+    if bus is not None:
+        # merge issues from batches other ranks analyzed for us —
+        # append_issue dedups exactly as the unsplit run would
+        migrated = bus.finalize_contract(report)
     issues = report.sorted_issues()
-    return {
+    out = {
         "contract": Path(path).name,
         "issues": len(issues),
         "swc": sorted({i["swc-id"] for i in issues}),
     }
+    if migrated:
+        out["migrated_batches"] = migrated
+    return out
 
 
 def _kv_client():
@@ -151,7 +162,7 @@ def _claim(client, item: str, owner: bool) -> bool:
 def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
                num_processes: int,
                analyze: Callable[[str], dict] = default_analyze,
-               steal: bool = True) -> dict:
+               steal: bool = True, bus=None) -> dict:
     """Analyze this rank's shard — then STEAL unstarted contracts from
     other ranks' shards (SURVEY §2.10 distributed-backend row: work
     moves between hosts over DCN when a shard drains early). Each item
@@ -197,11 +208,22 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
                     log.info("rank %d: stole %s from rank %d",
                              process_id, path, victim)
                     _run_one(path, stolen_from=victim)
+    migrated_served = 0
+    if bus is not None:
+        # whole contracts exhausted: this rank will publish no more
+        # offers (mark it BEFORE serving, so every rank entering the
+        # serve phase lets the others' serve loops terminate), then
+        # serve migrated PATH BATCHES from ranks still mid-analysis
+        bus.mark_done()
+        migrated_served = bus.serve_offers_until_done()
     shard_report = {
         "process_id": process_id,
         "num_processes": num_processes,
         "wall_s": round(time.perf_counter() - t0, 2),
         "stolen": sum(1 for r in results if "stolen_from" in r),
+        "migrated_batches_served": migrated_served,
+        "migrated_batches_out": sum(
+            r.get("migrated_batches", 0) for r in results),
         "results": results,
     }
     (out / f"shard_{process_id}.json").write_text(
@@ -226,7 +248,11 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
         merged["shards"].append(
             {"process_id": rank, "wall_s": data["wall_s"],
              "n": len(data["results"]),
-             "stolen": data.get("stolen", 0)})
+             "stolen": data.get("stolen", 0),
+             "migrated_batches_served":
+                 data.get("migrated_batches_served", 0),
+             "migrated_batches_out":
+                 data.get("migrated_batches_out", 0)})
         merged["stolen"] += data.get("stolen", 0)
         for r in data["results"]:
             key = r.get("path", r["contract"])
@@ -259,6 +285,10 @@ def main(argv=None) -> int:
     parser.add_argument("--no-steal", action="store_true",
                         help="static shards only (no cross-host "
                         "work-stealing when a shard drains early)")
+    parser.add_argument("--migrate", action="store_true",
+                        help="also migrate PATH BATCHES: a drained "
+                        "rank takes half of a busy rank's open-state "
+                        "wave mid-analysis (parallel/migrate.py)")
     parser.add_argument("files", nargs="+")
     args = parser.parse_args(argv)
 
@@ -266,11 +296,19 @@ def main(argv=None) -> int:
                             args.process_id)
     num_processes = args.num_processes or int(
         os.environ.get("MTPU_NUM_PROCESSES", 1))
+    bus = None
+    if args.migrate and num_processes > 1:
+        from .migrate import MigrationBus
+
+        bus = MigrationBus(args.out_dir, rank, num_processes,
+                           timeout=args.timeout,
+                           tpu_lanes=args.tpu_lanes)
     report = run_corpus(
         args.files, args.out_dir, rank, num_processes,
         analyze=lambda p: default_analyze(
-            p, timeout=args.timeout, tpu_lanes=args.tpu_lanes),
-        steal=not args.no_steal,
+            p, timeout=args.timeout, tpu_lanes=args.tpu_lanes,
+            bus=bus),
+        steal=not args.no_steal, bus=bus,
     )
     print(json.dumps(report))
     return 0

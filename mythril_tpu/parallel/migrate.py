@@ -1,0 +1,329 @@
+"""Cross-host PATH-BATCH migration for corpus mode (SURVEY §2.10,
+distributed-backend row: work moves between hosts over DCN when a
+shard drains early — not just unstarted contracts, but the open-state
+wave of a HALF-FINISHED analysis).
+
+Mechanism: at each symbolic transaction-round boundary the engine's
+open world states collapse to the serializable core the checkpoint
+format already carries (support/checkpoint.py: flat term-table,
+keccak-manager state, tx counter). A loaded victim answers a drained
+thief's request by exporting HALF its open states as a checkpoint-
+format batch; the thief resumes it through the ordinary checkpoint
+machinery (same contract, same remaining rounds) with its own engine
+and detector set, then ships the issues it found back. The victim
+merges them through Report.append_issue — the same dedup path an
+unsplit analysis uses — so the merged report is identical to a
+no-migration run.
+
+Coordination rides the corpus mode's shared --out-dir filesystem
+(which rank 0's merge already requires): request/offer/result files
+plus O_CREAT|O_EXCL claim files for atomicity. A crashed thief leaves
+a claimed-but-unanswered offer; the victim falls back to resuming the
+batch locally once every other rank is done or the thief writes a
+failure marker — work can migrate, but never be lost.
+
+Tested end-to-end by tests/test_migration.py: a rigged two-rank corpus
+where a mid-flight analysis migrates with identical merged reports.
+"""
+
+import json
+import logging
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: how long a victim waits on a CLAIMED offer after every other rank
+#: reported done (a live thief answers in far less; a dead one never)
+CLAIMED_WAIT_S = float(os.environ.get("MTPU_MIGRATE_WAIT", "60"))
+
+
+def code_identity(contract) -> str:
+    """The checkpoint code binding (support/checkpoint.py owns it)."""
+    from ..support.checkpoint import code_identity as _ci
+
+    return _ci(contract)
+
+
+def _claim(path: Path) -> bool:
+    """Atomic cross-rank claim via O_CREAT|O_EXCL on the shared dir."""
+    try:
+        fd = os.open(str(path), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+        return True
+    except FileExistsError:
+        return False
+
+
+class MigrationBus:
+    """One per corpus rank; mediates offers through the shared dir."""
+
+    def __init__(self, out_dir: str, rank: int, num_ranks: int,
+                 timeout: int = 60, tpu_lanes: int = 0):
+        self.dir = Path(out_dir) / "migrate"
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.rank = rank
+        self.num_ranks = num_ranks
+        self.timeout = timeout
+        self.tpu_lanes = tpu_lanes
+        #: offers this rank published and must resolve before its
+        #: contract report finalizes: offer id -> meta dict
+        self.outstanding = {}
+        self._offer_seq = 0
+        #: set by the victim hook while a contract is being analyzed
+        self.current_contract: Optional[str] = None
+
+    # -- signals -------------------------------------------------------------
+
+    def request_work(self) -> None:
+        (self.dir / f"request_{self.rank}").touch()
+
+    def withdraw_request(self) -> None:
+        try:
+            (self.dir / f"request_{self.rank}").unlink()
+        except FileNotFoundError:
+            pass
+
+    def _pending_requests(self) -> List[int]:
+        return [
+            int(p.name.split("_")[1])
+            for p in self.dir.glob("request_*")
+            if int(p.name.split("_")[1]) != self.rank
+        ]
+
+    def mark_done(self) -> None:
+        (self.dir / f"done_{self.rank}").touch()
+
+    def others_done(self) -> bool:
+        return all(
+            (self.dir / f"done_{r}").exists()
+            for r in range(self.num_ranks) if r != self.rank
+        )
+
+    # -- victim side ---------------------------------------------------------
+
+    def on_round_end(self, laser, next_round: int, tx_count: int,
+                     address) -> None:
+        """svm hook (laser/svm.py _execute_transactions): export half
+        the open states to a drained thief, in place."""
+        if next_round >= tx_count:
+            return  # no rounds left: nothing worth migrating
+        if not self._pending_requests():
+            return
+        states = laser.open_states
+        if len(states) < 2 or self.current_contract is None:
+            return
+        from ..smt import BitVec
+        from ..support.checkpoint import save_checkpoint
+
+        half = states[len(states) // 2:]
+        self._offer_seq += 1
+        offer_id = f"{self.rank}_{self._offer_seq}"
+        batch = self.dir / f"offer_{offer_id}.batch"
+        code_id = self._current_code_id
+        save_checkpoint(
+            str(batch), next_round, half,
+            address.value if isinstance(address, BitVec) else address,
+            code_id, include_modules=False)
+        if not batch.exists():  # save is best-effort; keep the states
+            return
+        del states[len(states) - len(half):]
+        meta = {
+            "contract": self.current_contract,
+            "code_id": code_id,
+            "tx_count": tx_count,
+            "round": next_round,
+            "victim": self.rank,
+        }
+        meta_path = self.dir / f"offer_{offer_id}.meta.json"
+        tmp = meta_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, meta_path)  # thieves glob for *.meta.json
+        self.outstanding[offer_id] = meta
+        log.info("rank %d: migrated %d open states (offer %s)",
+                 self.rank, len(half), offer_id)
+
+    def begin_contract(self, contract_path: str, contract) -> None:
+        self.current_contract = contract_path
+        self._current_code_id = code_identity(contract)
+
+    def finalize_contract(self, report) -> int:
+        """Wait for every outstanding offer's result and merge its
+        issues into the victim's report (append_issue dedups exactly
+        as an unsplit run would). Unclaimed/failed offers are resumed
+        locally. Returns the number of batches a REMOTE rank actually
+        analyzed (local fallbacks are not migrations)."""
+        merged = 0
+        for offer_id, meta in list(self.outstanding.items()):
+            issues, remote = self._collect(offer_id, meta)
+            for issue in issues:
+                report.append_issue(issue)
+            if remote:
+                merged += 1
+            del self.outstanding[offer_id]
+        self.current_contract = None
+        return merged
+
+    def _collect(self, offer_id: str,
+                 meta: dict) -> Tuple[List, bool]:
+        result = self.dir / f"result_{offer_id}.pkl"
+        failed = self.dir / f"failed_{offer_id}"
+        claim = self.dir / f"claim_{offer_id}"
+        while True:
+            if result.exists():
+                try:
+                    return _load_issues(result), True
+                except Exception as e:
+                    log.warning("migrated result unreadable (%s); "
+                                "re-running locally", e)
+                    break
+            if failed.exists():
+                break
+            if not claim.exists():
+                # nobody is working on it. If no thief is even asking
+                # (or everyone else is done), claim it ourselves and
+                # resume locally — two victims waiting on each other's
+                # offers must not deadlock. The claim keeps a late
+                # thief from duplicating the work.
+                if ((not self._pending_requests()
+                     or self.others_done())
+                        and _claim(claim)):
+                    break
+            else:
+                # a live thief heartbeats the claim file every
+                # transaction round; only a STALE claim times out —
+                # a slow-but-alive thief is never raced with a
+                # duplicate local run
+                try:
+                    age = time.time() - claim.stat().st_mtime
+                except OSError:
+                    age = 0.0
+                if age > CLAIMED_WAIT_S and self.others_done():
+                    log.warning("offer %s claimed but never answered; "
+                                "re-running locally", offer_id)
+                    break
+            time.sleep(0.2)
+        # local fallback: resume the batch with this rank's own engine
+        return analyze_batch(
+            meta, self.dir / f"offer_{offer_id}.batch",
+            self.timeout, self.tpu_lanes,
+            work_tag=f"victim{self.rank}"), False
+
+    # -- thief side ----------------------------------------------------------
+
+    def serve_offers_until_done(self) -> int:
+        """Drained rank: advertise, then claim and run migrated batches
+        until every other rank is done. Returns batches served."""
+        served = 0
+        self.request_work()
+        try:
+            while True:
+                took = False
+                for meta_path in sorted(self.dir.glob("offer_*.meta.json")):
+                    offer_id = meta_path.name[len("offer_"):
+                                              -len(".meta.json")]
+                    if (self.dir / f"result_{offer_id}.pkl").exists():
+                        continue
+                    if not _claim(self.dir / f"claim_{offer_id}"):
+                        continue
+                    took = True
+                    served += 1
+                    self._run_offer(offer_id, meta_path)
+                if not took:
+                    if self.others_done():
+                        return served
+                    time.sleep(0.2)
+        finally:
+            self.withdraw_request()
+
+    def _run_offer(self, offer_id: str, meta_path: Path) -> None:
+        try:
+            meta = json.loads(meta_path.read_text())
+            claim = self.dir / f"claim_{offer_id}"
+            issues = analyze_batch(
+                meta, self.dir / f"offer_{offer_id}.batch",
+                self.timeout, self.tpu_lanes,
+                work_tag=f"thief{self.rank}", heartbeat_path=claim)
+            _dump_issues(self.dir / f"result_{offer_id}.pkl", issues)
+            log.info("rank %d: served migrated batch %s (%d issues)",
+                     self.rank, offer_id, len(issues))
+        except Exception as e:
+            log.warning("migrated batch %s failed (%s)", offer_id, e)
+            (self.dir / f"failed_{offer_id}").touch()
+
+
+class _Heartbeat:
+    """Migration-bus stand-in for batch resumption: touches the claim
+    file at every transaction-round boundary so the victim can tell a
+    live slow thief from a dead one (no state ever migrates out of a
+    migrated batch — on_round_end only heartbeats)."""
+
+    def __init__(self, path: Path):
+        self._path = path
+
+    def on_round_end(self, laser, next_round, tx_count, address):
+        try:
+            os.utime(self._path)
+        except OSError:
+            pass
+
+
+def analyze_batch(meta: dict, batch_path, timeout: int,
+                  tpu_lanes: int, work_tag: str = "local",
+                  heartbeat_path: Optional[Path] = None) -> List:
+    """Resume a migrated batch through the ordinary checkpoint
+    machinery: same contract, remaining transaction rounds, this
+    rank's own engine + full detector set; returns Issue objects.
+    The batch is COPIED to a private work file first — the resuming
+    engine's checkpoint sink writes its own progress there, and the
+    shared offer file must stay immutable for fallback."""
+    from ..orchestration.mythril_analyzer import MythrilAnalyzer
+    from ..orchestration.mythril_disassembler import MythrilDisassembler
+    from ..support.analysis_args import make_cmd_args
+    from ..support.checkpoint import RESUME_STATS
+
+    batch_path = Path(batch_path)
+    work = batch_path.with_name(
+        f"{batch_path.stem}.{work_tag}.work")
+    shutil.copyfile(batch_path, work)
+    disassembler = MythrilDisassembler(eth=None)
+    code = Path(meta["contract"]).read_text().strip()
+    address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
+    cmd_args = make_cmd_args(
+        execution_timeout=timeout, tpu_lanes=tpu_lanes,
+        checkpoint=str(work),
+        migration_bus=(_Heartbeat(heartbeat_path)
+                       if heartbeat_path is not None else None))
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address)
+    loaded0 = RESUME_STATS["loaded"]
+    report = analyzer.fire_lasers(modules=None,
+                                  transaction_count=meta["tx_count"])
+    if RESUME_STATS["loaded"] == loaded0:
+        # the batch did not resume (corrupt file / identity mismatch):
+        # the run above was a FULL re-analysis — correct after dedup,
+        # but a migration that silently cost a whole contract must be
+        # loud
+        log.warning("migrated batch %s did not resume; a full "
+                    "re-analysis ran instead", batch_path.name)
+    return list(report.issues.values())
+
+
+def _dump_issues(path: Path, issues: List) -> None:
+    from ..support.checkpoint import dump_with_terms
+
+    tmp = path.with_suffix(".tmp")
+    with open(tmp, "wb") as f:
+        dump_with_terms(f, issues)
+    os.replace(tmp, path)
+
+
+def _load_issues(path: Path) -> List:
+    from ..support.checkpoint import load_with_terms
+
+    with open(path, "rb") as f:
+        return load_with_terms(f)

@@ -44,6 +44,20 @@ log = logging.getLogger(__name__)
 
 VERSION = 3
 
+#: observability: how many loads resumed vs fell back to fresh runs
+RESUME_STATS = {"loaded": 0, "failed": 0}
+
+
+def code_identity(contract) -> str:
+    """The code binding snapshots carry: multi-contract runs sharing
+    one checkpoint file (or migration batches crossing ranks) must
+    never resume each other's state."""
+    from hashlib import sha256
+
+    return sha256(
+        (contract.creation_code or contract.code or "").encode()
+    ).hexdigest()
+
 #: load-time table of saved-tid -> re-interned Term (set around the
 #: payload unpickling; term references resolve through it)
 _LOAD_TERMS: Dict[int, "T.Term"] = {}
@@ -142,11 +156,40 @@ def _module_state() -> Dict[str, Any]:
     return out
 
 
+def dump_with_terms(stream, obj) -> None:
+    """Term-safe pickling of an arbitrary object graph to a stream:
+    Terms serialize as flat-table references exactly as checkpoints do
+    (migration results carry Issue objects whose fields may reference
+    terms)."""
+    body = io.BytesIO()
+    pickler = _Pickler(body, protocol=pickle.HIGHEST_PROTOCOL)
+    pickler.dump(obj)
+    pickle.dump(_dag_rows(pickler.roots.values()), stream,
+                protocol=pickle.HIGHEST_PROTOCOL)
+    stream.write(body.getvalue())
+
+
+def load_with_terms(stream):
+    """Inverse of dump_with_terms."""
+    global _LOAD_TERMS
+
+    rows = pickle.load(stream)
+    _LOAD_TERMS = _intern_rows(rows)
+    try:
+        return _Unpickler(stream).load()
+    finally:
+        _LOAD_TERMS = {}
+
+
 def save_checkpoint(path: str, round_index: int, open_states,
-                    target_address: int, code_id: str) -> None:
+                    target_address: int, code_id: str,
+                    include_modules: bool = True) -> None:
     """Atomically write a resumable snapshot after a completed
     transaction round. Failures are logged, never raised — a
-    checkpoint must not kill the analysis it protects."""
+    checkpoint must not kill the analysis it protects.
+    include_modules=False writes a MIGRATION batch: the open states
+    travel, detector issues/caches stay with the exporting rank
+    (parallel/migrate.py)."""
     from ..laser.transaction import tx_id_manager
 
     try:
@@ -158,7 +201,7 @@ def save_checkpoint(path: str, round_index: int, open_states,
             "target_address": target_address,
             "tx_counter": tx_id_manager._next,
             "keccak": _keccak_state(),
-            "modules": _module_state(),
+            "modules": _module_state() if include_modules else {},
         })
         head = io.BytesIO()
         pickle.dump(
@@ -191,6 +234,7 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
 
     if not os.path.exists(path):
         return None
+    RESUME_STATS["failed"] += 1  # flipped to loaded on success
     try:
         with open(path, "rb") as f:
             head = pickle.load(f)
@@ -247,6 +291,8 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
             module.issues.extend(entry["issues"])
             module.cache.update(entry["cache"])
 
+    RESUME_STATS["failed"] -= 1
+    RESUME_STATS["loaded"] += 1
     log.info("checkpoint: resuming at round %d with %d open states",
              round_index, len(open_states))
     return {"round": round_index, "open_states": open_states,

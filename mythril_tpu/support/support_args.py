@@ -39,6 +39,8 @@ class Args(object, metaclass=Singleton):
         self.tpu_prefilter = True
         # transaction-boundary checkpoint/resume (support/checkpoint.py)
         self.checkpoint_file = None
+        # corpus-mode path-batch migration bus (parallel/migrate.py)
+        self.migration_bus = None
 
 
 args = Args()

@@ -111,9 +111,16 @@ class ModelCache:
                 # fork storm the plain scan never hits (every query is
                 # a distinct path condition) and re-pegging _scan to
                 # MAX would re-introduce the 100-model re-evaluation
-                # cost per query that the backoff exists to cut
+                # cost per query that the backoff exists to cut.
+                # More: the scan just missed END-TO-END and only repair
+                # saved the query, so DECAY the width — without this,
+                # repair-served storms kept paying the full 100-model
+                # evaluation before every repair (measured 219 s of
+                # term evaluation on a 16k-path sweep); a direct scan
+                # hit still re-grows the width geometrically
                 self.model_cache.put(fixed, 1)
                 self._repair_tries = REPAIR_MODELS
+                self._scan = max(self._scan // 2, self.MIN_SCAN)
                 return fixed
         self._misses += 1
         if self._misses >= 8:

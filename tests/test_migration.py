@@ -1,0 +1,83 @@
+"""Cross-host PATH-BATCH migration (SURVEY §2.10 distributed-backend
+row): a rigged two-rank corpus where rank 1 drains instantly and rank 0
+analyzes a heavy contract whose round-1 boundary has 4 open states —
+half of them must migrate to rank 1 mid-analysis, with the merged
+report identical to a no-migration run."""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from .fixture_paths import INPUTS
+
+HEAVY, LIGHT = "ether_send.sol.o", "nonascii.sol.o"
+
+
+def _corpus(tmp_path):
+    a = tmp_path / f"a_{HEAVY}"
+    b = tmp_path / f"b_{LIGHT}"
+    shutil.copy(INPUTS / HEAVY, a)
+    shutil.copy(INPUTS / LIGHT, b)
+    return [str(a), str(b)]
+
+
+def _run(tmp_path, files, out_name, migrate):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out_dir = tmp_path / out_name
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        # the victim's analysis starts late enough for the drained
+        # thief to be polling when round 1 ends, regardless of
+        # process-startup skew on the shared single CPU
+        env["MTPU_ANALYZE_DELAY"] = "ether_send=8,nonascii=0.1"
+        cmd = [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+               "--coordinator", f"127.0.0.1:{port}",
+               "--num-processes", "2", "--process-id", str(rank),
+               "--out-dir", str(out_dir), "--timeout", "90"]
+        if migrate:
+            cmd.append("--migrate")
+        procs.append(subprocess.Popen(
+            cmd + files, cwd="/root/repo", env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=900) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-3000:]
+    return json.loads((out_dir / "corpus_report.json").read_text())
+
+
+def _canon(report):
+    return [(c["contract"], c.get("issues"), c.get("swc"))
+            for c in report["contracts"]]
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+def test_midflight_batch_migrates_with_identical_report(tmp_path):
+    files = _corpus(tmp_path)
+
+    plain = _run(tmp_path, files, "plain", migrate=False)
+    moved = _run(tmp_path, files, "migrate", migrate=True)
+
+    assert _canon(plain) == _canon(moved), (
+        f"plain: {_canon(plain)}\nmigrated: {_canon(moved)}")
+    assert plain["errors"] == 0 and moved["errors"] == 0
+
+    # the migration actually happened: the victim exported at least
+    # one batch and some rank served at least one
+    out = sum(s.get("migrated_batches_out", 0)
+              for s in moved["shards"])
+    served = sum(s.get("migrated_batches_served", 0)
+                 for s in moved["shards"])
+    assert out >= 1, moved["shards"]
+    assert served >= 1, moved["shards"]
