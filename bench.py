@@ -478,20 +478,23 @@ def bench_config5(n_lanes=32768, k=15, host_k=12):
     host_code, host_paths = build_symbolic_contract(k=host_k)
     lane_engine.PATH_HISTORY[code] = n_paths
     width = lane_engine.pick_width(n_lanes, 1, code)
+    from mythril_tpu.smt import repair
+
     lane_engine.FORCE_WIDTH = width
     try:
         for bucket in (16, width):
-            warm_variant_ok = lane_engine.warm_variant(
+            lane_engine.warm_variant(
                 width, len(code), {}, lane_engine.DEFAULT_WINDOW,
                 8192, seed_bucket=bucket, block=True)
         host_s, host_n = _explore(host_code, 0)
         lane_engine.RUN_STATS_TOTAL = {}
+        repairs0 = dict(repair.STATS)
         lane_s, lane_n = _explore(code, n_lanes)
     finally:
         lane_engine.FORCE_WIDTH = None
     assert lane_n == n_paths, (lane_n, n_paths)
+    assert host_n == host_paths, (host_n, host_paths)
     stats = lane_engine.RUN_STATS_TOTAL
-    from mythril_tpu.smt import repair
 
     lane_pps = n_paths / lane_s
     host_pps = host_n / host_s
@@ -511,7 +514,8 @@ def bench_config5(n_lanes=32768, k=15, host_k=12):
             "drained_records": stats.get("records"),
             "parked_states": stats.get("parked"),
             "spill_reseeded": stats.get("reseeded"),
-            "model_repairs": dict(repair.STATS),
+            "model_repairs": {k: v - repairs0.get(k, 0)
+                              for k, v in repair.STATS.items()},
             "note": "host measured at 2^12 paths (rate ~flat in path "
                     "count for this shape); remaining scale levers are "
                     "host-side terminal materialization and the retire "

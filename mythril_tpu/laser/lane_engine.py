@@ -1123,7 +1123,8 @@ _ALU3 = {"ADDMOD": alu.addmod, "MULMOD": alu.mulmod}
 _ARITY = {name: 2 for name in _ALU2}
 _ARITY.update({name: 3 for name in _ALU3})
 _ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
-               "SLOAD": 1, "CALLDATALOAD": 1, "SHA3": 3})
+               "SLOAD": 1, "CALLDATALOAD": 1, "SHA3": 3,
+               "BALANCE": 1})
 
 
 #: steps per fused dispatch. The in-dispatch while_loop exits as soon
@@ -1594,6 +1595,11 @@ class LaneEngine:
         if opname == "SLOAD":
             return _storage_read_term(ctx.storage_seed_raw,
                                       alu.to_bitvec(args[0]))
+        if opname == "BALANCE":
+            # symbolic address: the interpreter reads the global
+            # balances array directly (instructions.py balance_)
+            return ctx.template.world_state.balances[
+                alu.to_bitvec(args[0])]
         if opname == "SHA3":
             # device-read input words + packed meta (length + per-byte
             # memory kinds). Rebuild the hash input byte-for-byte the
@@ -1750,7 +1756,7 @@ class LaneEngine:
                             ("o", prov[(idx // d_recs,
                                         idx % d_recs)]))
                 # SLOAD/CALLDATALOAD resolve against per-seed context
-                if opname in ("SLOAD", "CALLDATALOAD"):
+                if opname in ("SLOAD", "CALLDATALOAD", "BALANCE"):
                     key_parts.append(("ctx", id(ctx.template)))
                 # annotated arithmetic is per-site AND per-seed: two
                 # executions at different pcs (or from different entry

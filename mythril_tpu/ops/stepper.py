@@ -124,6 +124,8 @@ def _build_tables():
     # shared stack-effect tables need its pops/pushes
     npop[_OP["SHA3"]] = 2
     npush[_OP["SHA3"]] = 1
+    npop[_OP["BALANCE"]] = 1
+    npush[_OP["BALANCE"]] = 1
     sup("MLOAD", 1, 1)
     sup("MSTORE", 2, 0)
     sup("MSTORE8", 2, 0)

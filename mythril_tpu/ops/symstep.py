@@ -108,7 +108,8 @@ def _build_sym_tables():
 
     defer_ops = (
         "ADD MUL SUB DIV SDIV MOD SMOD ADDMOD MULMOD EXP SIGNEXTEND "
-        "LT GT SLT SGT EQ ISZERO AND OR XOR NOT BYTE SHL SHR SAR"
+        "LT GT SLT SGT EQ ISZERO AND OR XOR NOT BYTE SHL SHR SAR "
+        "BALANCE"
     ).split()
     for name in defer_ops:
         deferrable[_OP[name]] = True
@@ -475,6 +476,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     is_jumpi = op == _OP["JUMPI"]
     is_exp = op == _OP["EXP"]
     is_sha3 = op == _OP["SHA3"]
+    is_balance = op == _OP["BALANCE"]
 
     # ---- memory offsets / fees (needed before park resolution) -----------
     # SHA3 with a concrete 32/64-byte length reads memory like MLOAD
@@ -773,6 +775,11 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         # length, non-word-readable input) parks — the in-place resume
         # path handles it host-side
         | (is_sha3 & ~sha3_defer)
+        # BALANCE defers only for SYMBOLIC addresses (a pure select
+        # over the world balances array); a concrete address must park
+        # — the interpreter's handler may auto-create the account
+        # (instructions.py balance_ / accounts_exist_or_load)
+        | (is_balance & ~sym_a)
         # storage: symbolic keys run in mode; the one park left is a
         # first symbolic-key access over unrecorded prior writes
         | mode_park

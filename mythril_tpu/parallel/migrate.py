@@ -88,11 +88,23 @@ class MigrationBus:
             pass
 
     def _pending_requests(self) -> List[int]:
-        return [
-            int(p.name.split("_")[1])
-            for p in self.dir.glob("request_*")
-            if int(p.name.split("_")[1]) != self.rank
-        ]
+        """Other ranks' LIVE work requests. A polling thief refreshes
+        its request file every loop (and heartbeats it while analyzing
+        a batch), so a request untouched for CLAIMED_WAIT_S is a dead
+        rank's leftover and must not gate anyone's local fallback."""
+        out = []
+        now = time.time()
+        for p in self.dir.glob("request_*"):
+            rank = int(p.name.split("_")[1])
+            if rank == self.rank:
+                continue
+            try:
+                if now - p.stat().st_mtime > CLAIMED_WAIT_S:
+                    continue
+            except OSError:
+                continue
+            out.append(rank)
+        return out
 
     def mark_done(self) -> None:
         (self.dir / f"done_{self.rank}").touch()
@@ -201,7 +213,7 @@ class MigrationBus:
                     age = time.time() - claim.stat().st_mtime
                 except OSError:
                     age = 0.0
-                if age > CLAIMED_WAIT_S and self.others_done():
+                if age > CLAIMED_WAIT_S:
                     log.warning("offer %s claimed but never answered; "
                                 "re-running locally", offer_id)
                     break
@@ -222,6 +234,9 @@ class MigrationBus:
         try:
             while True:
                 took = False
+                # a live poller keeps its request fresh: victims treat
+                # stale request files as a dead thief's leftovers
+                self.request_work()
                 for meta_path in sorted(self.dir.glob("offer_*.meta.json")):
                     offer_id = meta_path.name[len("offer_"):
                                               -len(".meta.json")]
@@ -230,8 +245,8 @@ class MigrationBus:
                     if not _claim(self.dir / f"claim_{offer_id}"):
                         continue
                     took = True
-                    served += 1
-                    self._run_offer(offer_id, meta_path)
+                    if self._run_offer(offer_id, meta_path):
+                        served += 1
                 if not took:
                     if self.others_done():
                         return served
@@ -239,41 +254,60 @@ class MigrationBus:
         finally:
             self.withdraw_request()
 
-    def _run_offer(self, offer_id: str, meta_path: Path) -> None:
+    def _run_offer(self, offer_id: str, meta_path: Path) -> bool:
         try:
             meta = json.loads(meta_path.read_text())
             claim = self.dir / f"claim_{offer_id}"
-            issues = analyze_batch(
-                meta, self.dir / f"offer_{offer_id}.batch",
-                self.timeout, self.tpu_lanes,
-                work_tag=f"thief{self.rank}", heartbeat_path=claim)
+            request = self.dir / f"request_{self.rank}"
+            with _Heartbeat(claim, request):
+                issues = analyze_batch(
+                    meta, self.dir / f"offer_{offer_id}.batch",
+                    self.timeout, self.tpu_lanes,
+                    work_tag=f"thief{self.rank}")
             _dump_issues(self.dir / f"result_{offer_id}.pkl", issues)
             log.info("rank %d: served migrated batch %s (%d issues)",
                      self.rank, offer_id, len(issues))
+            return True
         except Exception as e:
             log.warning("migrated batch %s failed (%s)", offer_id, e)
             (self.dir / f"failed_{offer_id}").touch()
+            return False
+
+
+import threading
 
 
 class _Heartbeat:
-    """Migration-bus stand-in for batch resumption: touches the claim
-    file at every transaction-round boundary so the victim can tell a
-    live slow thief from a dead one (no state ever migrates out of a
-    migrated batch — on_round_end only heartbeats)."""
+    """Background toucher: keeps a claim/request file's mtime fresh
+    while its owner is alive, so staleness checks can tell a slow
+    worker from a dead one at any analysis length."""
 
-    def __init__(self, path: Path):
-        self._path = path
+    PERIOD_S = 5.0
 
-    def on_round_end(self, laser, next_round, tx_count, address):
-        try:
-            os.utime(self._path)
-        except OSError:
-            pass
+    def __init__(self, *paths: Path):
+        self._paths = paths
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(self.PERIOD_S):
+            for p in self._paths:
+                try:
+                    os.utime(p)
+                except OSError:
+                    pass
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=2)
 
 
 def analyze_batch(meta: dict, batch_path, timeout: int,
-                  tpu_lanes: int, work_tag: str = "local",
-                  heartbeat_path: Optional[Path] = None) -> List:
+                  tpu_lanes: int, work_tag: str = "local") -> List:
     """Resume a migrated batch through the ordinary checkpoint
     machinery: same contract, remaining transaction rounds, this
     rank's own engine + full detector set; returns Issue objects.
@@ -294,9 +328,7 @@ def analyze_batch(meta: dict, batch_path, timeout: int,
     address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
     cmd_args = make_cmd_args(
         execution_timeout=timeout, tpu_lanes=tpu_lanes,
-        checkpoint=str(work),
-        migration_bus=(_Heartbeat(heartbeat_path)
-                       if heartbeat_path is not None else None))
+        checkpoint=str(work))
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address)

@@ -605,3 +605,24 @@ def test_sharded_engine_differential():
     assert len(host_sigs) == len(dev_sigs)
     for hs, ds in zip(host_sigs, dev_sigs):
         assert hs == ds, f"\nhost: {hs}\ndev:  {ds}"
+
+
+def test_balance_symbolic_address_defers():
+    # BALANCE(calldata word) — a pure select over the world balances
+    # array — must defer on device with a host-identical term; a
+    # concrete-address BALANCE must still park (account auto-creation
+    # stays host-side)
+    code = bytes(
+        push(0, 1) + asm("CALLDATALOAD", "BALANCE")
+        + push(3, 1) + asm("SSTORE")
+        + asm("STOP")
+    )
+    eng = differential(code, expect_paths=1)
+    assert eng.stats["records"] >= 2  # CDL + BALANCE deferred
+
+    code2 = bytes(
+        push(0xAB, 1) + asm("BALANCE")
+        + push(3, 1) + asm("SSTORE")
+        + asm("STOP")
+    )
+    differential(code2, expect_paths=1)
