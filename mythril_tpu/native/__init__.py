@@ -93,6 +93,14 @@ def get_lib() -> ctypes.CDLL:
             pass  # stale library: per-literal value() still works
         lib.mtpu_sat_stats.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.mtpu_sat_stats.restype = ctypes.c_int64
+        if hasattr(lib, "mtpu_sat_seed_phases"):
+            lib.mtpu_sat_seed_phases.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int8),
+                ctypes.c_int32,
+            ]
+            lib.mtpu_sat_seed_phases.restype = None
         # blaster bindings are optional: a stale library without them
         # must still serve SAT/keccak (make_blaster falls back to the
         # Python Blaster when the symbols are absent)
@@ -258,6 +266,21 @@ class SatSolver:
         out = (ctypes.c_int8 * n)()
         self._lib.mtpu_sat_values(self._h, arr, n, out)
         return out
+
+    def seed_phases(self, var_vals) -> None:
+        """Bias decision phases toward a known-good assignment:
+        var_vals is an iterable of (DIMACS var, bool). No-op on a
+        stale library without the symbol."""
+        if not hasattr(self._lib, "mtpu_sat_seed_phases"):
+            return
+        pairs = list(var_vals)
+        if not pairs:
+            return
+        n = len(pairs)
+        vars_arr = (ctypes.c_int32 * n)(*[v for v, _ in pairs])
+        vals_arr = (ctypes.c_int8 * n)(*[1 if b else 0
+                                         for _, b in pairs])
+        self._lib.mtpu_sat_seed_phases(self._h, vars_arr, vals_arr, n)
 
     def stats(self) -> dict:
         return {

@@ -753,6 +753,28 @@ int32_t mtpu_sat_assignment(void* sp, int8_t* out, int32_t cap) {
   }
   return n;
 }
+// Seed saved phases from a known-good assignment (DIMACS vars with
+// 0/1 values): decisions then walk toward that assignment first, so a
+// quick-sat/repaired model turns a cold 100k-variable instance into a
+// near-propagation-only first solve. Purely a search bias — never
+// affects satisfiability or soundness.
+void mtpu_sat_seed_phases(void* sp, const int32_t* vars,
+                          const int8_t* vals, int32_t n) {
+  Solver* s = (Solver*)sp;
+  for (int32_t i = 0; i < n; ++i) {
+    Var v = vars[i] - 1;
+    if (v < 0) continue;
+    while (v >= (int32_t)s->assign.size()) s->new_var();
+    s->saved_phase[v] = vals[i] ? T : F;
+    // decide seeded INPUT vars before the zero-activity Tseitin gate
+    // vars: gates decided first (default-false) would propagate input
+    // bits away from the hint with no conflict, silently discarding
+    // the warm start (verified empirically in review)
+    s->activity[v] = 1.0;
+    if (s->heap_pos[v] >= 0) s->heap_up(s->heap_pos[v]);
+  }
+}
+
 int64_t mtpu_sat_stats(void* sp, int32_t which) {
   Solver* s = (Solver*)sp;
   switch (which) {

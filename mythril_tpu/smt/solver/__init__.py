@@ -26,10 +26,17 @@ class BaseSolver:
         self.minimize_terms: List = []
         self.maximize_terms: List = []
         self._last = None
+        self._phase_hint = None
 
     def set_timeout(self, timeout: int) -> None:
         """Timeout in milliseconds (parity: solver.py:23-30)."""
         self.timeout_ms = timeout
+
+    def set_phase_hint(self, model_data) -> None:
+        """Warm-start the decision phases from a model satisfying the
+        constraints (optimization queries: quick-sat/repair supplies
+        it; the objective bound search then starts near a solution)."""
+        self._phase_hint = model_data
 
     def add(self, *constraints) -> None:
         for c in constraints:
@@ -51,6 +58,7 @@ class BaseSolver:
                 timeout_s=self.timeout_ms / 1000.0,
                 minimize=[m.raw for m in self.minimize_terms],
                 maximize=[m.raw for m in self.maximize_terms],
+                phase_hint=self._phase_hint,
             )
         except Exception as e:  # parity: z3 crashes map to unknown
             log.info("solver exception treated as unknown: %r", e)

@@ -18,6 +18,7 @@ uses (reference mythril/laser/smt/solver/solver.py:18-121). Pipeline:
 6. model extraction back through the substitution and Ackermann maps.
 """
 
+import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -27,6 +28,8 @@ from ..interval import interval as abs_interval
 from ...native import SatSolver
 
 SAT, UNSAT, UNKNOWN = "sat", "unsat", "unknown"
+
+log = logging.getLogger(__name__)
 
 
 class ModelData:
@@ -407,12 +410,44 @@ def _check_incremental(ctx, work, timeout_s, conflict_budget,
     return ctx
 
 
+def _seed_phases_from_hint(blaster, sat, work, hint) -> int:
+    """Bias the fresh instance's decision phases toward a model that
+    satisfies the (un-optimized) constraints — quick-sat/repair hands
+    the optimizer a warm start, collapsing the cold first solve of a
+    ~100k-variable instance to near-pure propagation. Returns bits
+    seeded (observability)."""
+    found: List["T.Term"] = []
+    seen: set = set()
+    for a in work:
+        # one shared seen set: assertions share large DAGs, and a
+        # per-assertion walk would revisit every shared subterm
+        T.collect(a, lambda x: x.op == T.BV_VAR, found, seen)
+    pairs = []
+    bv = hint.bv
+    for v in found:
+        val = bv.get(v.name)
+        if val is None:
+            continue
+        try:
+            bits = blaster.bits(v)
+        except Exception:
+            continue
+        for i, lit in enumerate(bits):
+            if not isinstance(lit, int) or lit == 0:
+                continue
+            want = (int(val) >> i) & 1
+            pairs.append((abs(lit), bool(want) ^ (lit < 0)))
+    sat.seed_phases(pairs)
+    return len(pairs)
+
+
 def check(
     assertions: List["T.Term"],
     timeout_s: float = 10.0,
     conflict_budget: int = 0,
     minimize: List["T.Term"] = (),
     maximize: List["T.Term"] = (),
+    phase_hint=None,
 ) -> CheckContext:
     """Decide conjunction of Bool terms; optionally lexicographically
     minimize the given BV terms (used by Optimize for tx-sequence
@@ -465,6 +500,11 @@ def check(
     blaster = make_blaster(sat)
     for a in work:
         blaster.assert_term(a)
+    if phase_hint is not None:
+        try:
+            _seed_phases_from_hint(blaster, sat, work, phase_hint)
+        except Exception as e:  # a bias, never an error path
+            log.debug("phase seeding skipped: %s", e)
 
     remaining = timeout_s - (time.monotonic() - t0)
     if remaining <= 0:

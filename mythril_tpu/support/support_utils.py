@@ -132,6 +132,13 @@ class ModelCache:
     def put(self, model, weight) -> None:
         self.model_cache.put(model, weight)
 
+    def most_recent(self):
+        """Newest cached model, or None (phase-seed donor even when
+        quick-sat misses)."""
+        for model in reversed(self.model_cache.lru_cache.keys()):
+            return model
+        return None
+
 
 def fold_concrete_bytes(seq) -> list:
     """Normalize a byte sequence that may mix ints, concrete BitVec(8)s
