@@ -2255,7 +2255,6 @@ class LaneEngine:
                         int(r[4]), int(np.uint32(r[5])),
                         int(np.uint32(r[6])), int(r[7]), int(r[8]),
                     ))
-                self._prov, dead = self._drain_host(recs, forks, ctxs)
                 status = counts_h["status"].copy()
                 steps = counts_h["steps"]
                 # forked children consumed slots from the top (tail) of the
@@ -2264,11 +2263,118 @@ class LaneEngine:
                 if consumed:
                     free = free[: n_free_written - consumed]
 
-                dead_set = set(dead)
-                # 1. fast-retired lanes: the window dispatch already
-                # gathered their rows and marked them DEAD (ridx row i is
-                # the i-th retired lane; padding entries hold n)
+                # fast-retired lanes: the window dispatch already
+                # gathered their rows and marked them DEAD (ridx row i
+                # is the i-th retired lane; padding entries hold n)
                 fast = [int(x) for x in ridx if x < n]
+                # escalation set: parked lanes past the fast budget or
+                # over a column floor (status still NEEDS_HOST), plus
+                # runaways
+                runaway = (status == Status.RUNNING) \
+                    & (steps >= self.step_budget)
+                rest = np.nonzero(
+                    (status == Status.NEEDS_HOST) | runaway)[0].tolist()
+                # in-place resume candidates: the device held SHA3-
+                # parked lanes in the envelope and shipped their slim
+                # rows with this window's output. Resolving them needs
+                # the drain's provisional-sid map, so the actual
+                # _try_resume runs AFTER the drain below; here the
+                # held set is only carved out of the escalation retire
+                # (optimistically — a declined lane retires through
+                # the supplementary dispatch afterwards).
+                held = [int(x) for x in hidx if x < n]
+                cap_r = small
+                if len(held) > small and warm_variant(
+                    self.n_lanes, len(code_bytes),
+                    self.lane_kwargs, self.window,
+                    self.step_budget, seed_bucket=self.n_lanes,
+                ):
+                    cap_r = self.n_lanes
+                held = held[:cap_r]
+                if held:
+                    held_set = set(held)
+                    rest = [l for l in rest if l not in held_set]
+                # DISPATCH the escalation retire before the host drain:
+                # the device gathers and ships the rows (the largest
+                # per-window transfer) while the host resolves this
+                # window's records and forks — the two biggest
+                # per-window costs overlap instead of serializing
+                def _retire_floors(lanes_sel):
+                    c = counts_h
+                    sel = np.asarray(lanes_sel, np.int32)
+                    lk = self.lane_kwargs
+                    return (
+                        _geo_bucket(max(int(c["sp"][sel].max()), 1),
+                                    lk.get("stack_depth", 64), 8),
+                        _geo_bucket(max(int(c["msize"][sel].max()), 1),
+                                    lk.get("memory_bytes", 4096), 64),
+                        _geo_bucket(
+                            max(int(c["mlog_count"][sel].max()), 1),
+                            lk.get("mem_records", 64), 8),
+                        _geo_bucket(max(int(c["scount"][sel].max()), 1),
+                                    lk.get("storage_slots", 64), 8),
+                    )
+
+                def _padded_idx(lanes_sel):
+                    kp = _geo_bucket(len(lanes_sel), self.n_lanes,
+                                     min(64, self.n_lanes))
+                    idx_arr = np.full(kp, self.n_lanes, np.int32)
+                    idx_arr[: len(lanes_sel)] = lanes_sel
+                    return idx_arr
+
+                def _materialize_rows(lanes_sel, rows_host):
+                    with _prof("materialize"):
+                        for row, lane in enumerate(lanes_sel):
+                            self.stats["device_steps"] += \
+                                int(steps[lane])
+                            if lane not in dead_set:
+                                results.append(self.materialize(
+                                    rows_host, row, ctxs[lane]))
+                            ctxs[lane] = None
+                            free.append(lane)
+                    status[np.asarray(lanes_sel, np.int32)] = DEAD
+
+                rows = None
+                if rest:
+                    floors = _retire_floors(rest)
+                    with _prof("retire_dispatch"):
+                        st, rows = _retire_rows(
+                            st, jnp.asarray(_padded_idx(rest)), *floors)
+                        for arr in rows:
+                            try:
+                                arr.copy_to_host_async()
+                            except Exception:
+                                pass  # backend without async copies
+
+                self._prov, dead = self._drain_host(recs, forks, ctxs)
+                dead_set = set(dead)
+
+                # in-place resume (needs self._prov): patches ride the
+                # next dispatch's seed buffer — zero extra round trips.
+                # A trivially-false (dead) lane must NOT resume: the
+                # next dispatch's kill would race its patch (kill sets
+                # DEAD before patches set RUNNING) while the host has
+                # already freed its slot — route dead lanes to the
+                # supplementary retire instead.
+                declined: List[int] = []
+                if held:
+                    pcs = counts_h["pc"]
+                    rrows = _unpack_resume((h_i32, h_u32, h_u8))
+                    with _prof("resume_host"):
+                        for row_i, lane in enumerate(held):
+                            patch = None
+                            if lane not in dead_set:
+                                patch = self._try_resume(
+                                    rrows, row_i,
+                                    int(pcs[lane]),
+                                    int(counts_h["sp"][lane]))
+                            if patch is not None:
+                                resumes.append((lane,) + patch)
+                                status[lane] = Status.RUNNING
+                                self.stats["resumed"] += 1
+                            else:
+                                declined.append(lane)
+
                 if fast:
                     st_fast = _unpack_rows((r_i32, r_u32, r_u8),
                                            *RETIRE_FLOORS)
@@ -2280,86 +2386,29 @@ class LaneEngine:
                                     st_fast, row, ctxs[lane]))
                             ctxs[lane] = None
                             free.append(lane)
-                # 2. escalation: parked lanes past the fast budget or over
-                # a column floor (status still NEEDS_HOST), plus runaways
-                runaway = (status == Status.RUNNING) \
-                    & (steps >= self.step_budget)
-                rest = np.nonzero(
-                    (status == Status.NEEDS_HOST) | runaway)[0].tolist()
-                # 2a. in-place resume: the device held SHA3-parked lanes
-                # in the envelope and shipped their slim rows with this
-                # window's output; build the keccak term host-side and
-                # patch them with the next dispatch — zero extra round
-                # trips. Declined lanes fall through to escalation.
-                held = [int(x) for x in hidx if x < n]
-                if held:
-                    # patches ride the next dispatch's seed buffer,
-                    # whose resume section holds `small` rows until the
-                    # full-width variant is warm; excess held lanes
-                    # fall through to escalation this window
-                    cap_r = small
-                    if len(held) > small and warm_variant(
-                        self.n_lanes, len(code_bytes),
-                        self.lane_kwargs, self.window,
-                        self.step_budget, seed_bucket=self.n_lanes,
-                    ):
-                        cap_r = self.n_lanes
-                    pcs = counts_h["pc"]
-                    rrows = _unpack_resume((h_i32, h_u32, h_u8))
-                    with _prof("resume_host"):
-                        for row_i, lane in enumerate(held):
-                            if row_i >= cap_r or lane in dead_set:
-                                continue
-                            patch = self._try_resume(
-                                rrows, row_i,
-                                int(pcs[lane]),
-                                int(counts_h["sp"][lane]))
-                            if patch is not None:
-                                resumes.append((lane,) + patch)
-                                status[lane] = Status.RUNNING
-                                self.stats["resumed"] += 1
-                    if resumes:
-                        kept = {r[0] for r in resumes}
-                        rest = [l for l in rest if l not in kept]
                 if rest:
-                    c = counts_h
-                    rsel = np.asarray(rest, np.int32)
-                    lk = self.lane_kwargs
-                    dstack = _geo_bucket(
-                        max(int(c["sp"][rsel].max()), 1),
-                        lk.get("stack_depth", 64), 8)
-                    dmem = _geo_bucket(
-                        max(int(c["msize"][rsel].max()), 1),
-                        lk.get("memory_bytes", 4096), 64)
-                    dmlog = _geo_bucket(
-                        max(int(c["mlog_count"][rsel].max()), 1),
-                        lk.get("mem_records", 64), 8)
-                    dslot = _geo_bucket(
-                        max(int(c["scount"][rsel].max()), 1),
-                        lk.get("storage_slots", 64), 8)
-                    kr = _geo_bucket(len(rest), self.n_lanes,
-                                     min(64, self.n_lanes))
-                    ridx2 = np.full(kr, self.n_lanes, np.int32)
-                    ridx2[: len(rest)] = rest
                     with _prof("retire_pull"):
-                        st, rows = _retire_rows(st, jnp.asarray(ridx2),
-                                                dstack, dmem, dmlog, dslot)
                         st_host = _unpack_rows(jax.device_get(rows),
-                                               dstack, dmem, dmlog, dslot)
-                    with _prof("materialize"):
-                        for row, lane in enumerate(rest):
-                            self.stats["device_steps"] += int(steps[lane])
-                            if lane not in dead_set:
-                                results.append(self.materialize(
-                                    st_host, row, ctxs[lane]))
-                            ctxs[lane] = None
-                            free.append(lane)
-                    status[rsel] = DEAD
+                                               *floors)
+                    _materialize_rows(rest, st_host)
+                if declined:
+                    # rare: held lanes the host would not resume
+                    # (symbolic length, OOG, oversize, trivially-false
+                    # path) retire through a supplementary dispatch —
+                    # they must not stay held forever
+                    dfloors = _retire_floors(declined)
+                    with _prof("retire_pull"):
+                        st, drows = _retire_rows(
+                            st, jnp.asarray(_padded_idx(declined)),
+                            *dfloors)
+                        d_host = _unpack_rows(jax.device_get(drows),
+                                              *dfloors)
+                    _materialize_rows(declined, d_host)
                 # 3. trivially-false lanes still RUNNING on device: kill
                 # them at the next dispatch (before it seeds anything) and
                 # recycle their slots after it. Their host status stays
                 # RUNNING so the loop always runs that dispatch.
-                retired = set(fast) | set(rest)
+                retired = set(fast) | set(rest) | set(declined)
                 for lane in dead:
                     if lane not in retired:
                         kill.append(lane)

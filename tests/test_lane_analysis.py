@@ -64,9 +64,19 @@ def _analyze(file_name, module, tx_count, tpu_lanes):
 
 
 def _strip_volatile(obj):
-    """Remove wall-clock fields from a report structure in place."""
+    """Remove wall-clock and solver-choice-dependent fields in place.
+    testCase initialState BALANCES are free model values (capped, not
+    minimized) and legitimately differ between engines whose query
+    order and model warm-starts differ; account sets, code, nonces and
+    storage stay compared, as do the minimized exploit calldata and
+    call values."""
     if isinstance(obj, dict):
         obj.pop("discoveryTime", None)
+        init = obj.get("initialState")
+        if isinstance(init, dict):
+            for acct in (init.get("accounts") or {}).values():
+                if isinstance(acct, dict):
+                    acct.pop("balance", None)
         for v in obj.values():
             _strip_volatile(v)
     elif isinstance(obj, list):
