@@ -234,11 +234,31 @@ class IntegerArithmetics(DetectionModule):
                     )
 
     def _handle_transaction_end(self, state: GlobalState) -> List[Issue]:
+        from ....support.support_args import args
+        from ....support.support_utils import get_code_hash
+
         state_annotation = _get_overflowunderflow_state_annotation(state)
         issues = []
         for annotation in state_annotation.overflowing_state_annotations:
             ostate = annotation.overflowing_state
             if ostate in self._ostates_unsatisfiable:
+                continue
+            # site-level dedup BEFORE the solver: a later tx-end state
+            # re-carries every promoted annotation, so without this an
+            # already-reported site pays a second feasibility + full
+            # tx-sequence optimize whose Issue the report dedup then
+            # discards (the reference avoids the rerun only incidentally,
+            # via its dependency pruner dropping the revisit path)
+            if (
+                self.cache
+                and self.auto_cache
+                and not args.use_issue_annotations
+                and (
+                    ostate.get_current_instruction()["address"],
+                    get_code_hash(ostate.environment.code.bytecode),
+                )
+                in self.cache
+            ):
                 continue
             if ostate not in self._ostates_satisfiable:
                 try:

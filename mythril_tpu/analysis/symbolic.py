@@ -41,6 +41,22 @@ from .ops import Call, VarType, get_variable
 log = logging.getLogger(__name__)
 
 
+def _device_exec_ok() -> bool:
+    """If the sweep would bail at runtime, the host path must not
+    silently run without the pruner (support.devices.device_exec_ok —
+    one executed-op probe per process, importable lane engine)."""
+    try:
+        from ..laser.lane_engine import LaneEngine  # noqa: F401
+        from ..support.devices import device_exec_ok
+
+        if device_exec_ok():
+            return True
+        log.warning("lane engine unavailable; host pruners kept")
+    except Exception as e:
+        log.warning("lane engine unavailable (%s); host pruners kept", e)
+    return False
+
+
 class SymExecWrapper:
     """Symbolically executes the code and pre-parses the statespace."""
 
@@ -166,22 +182,8 @@ class SymExecWrapper:
                 if ad is None or "JUMPI" not in ad.lifted_hooks:
                     lane_engine_active = False
                     break
-        if lane_engine_active:
-            # probe availability with an actual op (device enumeration
-            # can succeed while execution is broken): if the sweep would
-            # bail at runtime, the host path must not silently run
-            # without the pruner
-            try:
-                from ..laser.lane_engine import LaneEngine  # noqa: F401
-                import jax
-                import jax.numpy as jnp
-
-                jax.block_until_ready(jnp.zeros(()) + 1)
-            except Exception as e:
-                logging.getLogger(__name__).warning(
-                    "lane engine unavailable (%s); host pruners kept", e
-                )
-                lane_engine_active = False
+        if lane_engine_active and not _device_exec_ok():
+            lane_engine_active = False
         if not disable_dependency_pruning and not lane_engine_active:
             plugin_loader.load(DependencyPrunerBuilder())
         elif lane_engine_active:

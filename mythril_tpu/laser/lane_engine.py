@@ -1019,6 +1019,9 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
     window of the exact production shapes, plus the escalation gathers
     that variant can fall back to mid-run."""
     from ..ops.stepper import _code_bucket
+    from ..support.devices import device_exec_ok
+
+    device_exec_ok()  # pull the once-per-process probe into warm-up
 
     eng = LaneEngine(n_lanes=n_lanes, window=window,
                      step_budget=step_budget, **lane_kwargs)

@@ -21,7 +21,15 @@ Two ideas make the forcing pass land on real EVM path conditions:
 * **donor evaluation of hard sides** — a comparison against a term the
   forcer cannot decompose (a balance select, an arithmetic chain) uses
   the donor model's value for that term as the bound and forces only
-  the tractable side.
+  the tractable side;
+* **modular inversion through arithmetic** — on low-contiguous masks
+  (which every full-width overflow literal has) ADD/SUB/MUL-by-odd are
+  invertible mod 2^k, so a requirement on a sum/product becomes a
+  requirement on one operand with the donor's value for the other; a
+  symbolic SELECT index or APPLY argument is pinned to the cell the
+  donor resolves it to.  This is what lets repairs land on
+  arithmetic-overflow witnesses over keccak-laden balance reads, not
+  just branch-bit literals.
 
 Soundness rests entirely on the final evaluation: a repair is returned
 only when the complete formula evaluates to True under the patched
@@ -45,6 +53,7 @@ MAX_FAILED = 48
 STATS = {"attempts": 0, "repaired": 0}
 
 _Cell = Tuple  # ("bv", name) | ("arr", name, idx) | ("bool", name)
+#              | ("func", name, argvals)
 
 
 _mask = T._mask
@@ -92,9 +101,22 @@ class _Repairer:
             return self._merge(("bv", t.name), mask, val)
         if op == T.SELECT:
             arr, idx = t.args
-            if arr.op == T.ARRAY_VAR and idx.op == T.BV_CONST:
-                return self._merge(("arr", arr.name, idx.val), mask, val)
+            if arr.op == T.ARRAY_VAR:
+                # symbolic index (balances[keccak(slot)]): pin the cell
+                # the DONOR resolves the index to — if the patch later
+                # perturbs the index, the final verification rejects it
+                iv = idx.val if idx.op == T.BV_CONST else self._ev(idx)
+                if isinstance(iv, int):
+                    return self._merge(("arr", arr.name, iv), mask, val)
             return False
+        if op == T.APPLY:
+            argv = []
+            for a in t.args:
+                av = a.val if a.op == T.BV_CONST else self._ev(a)
+                if not isinstance(av, int):
+                    return False
+                argv.append(av)
+            return self._merge(("func", t.name, tuple(argv)), mask, val)
         if op == T.EXTRACT:
             _hi, lo = t.params
             return self.force(t.args[0], mask << lo, val << lo)
@@ -114,24 +136,79 @@ class _Repairer:
                     return False
                 pos += part.width
             return True
-        if op == T.BAND:
-            for c, other in (t.args, reversed(t.args)):
-                if c.op == T.BV_CONST:
-                    if val & ~c.val:
-                        return False  # need a 1 where the AND forces 0
-                    return self.force(other, mask & c.val, val)
+        if op in (T.BAND, T.BOR, T.BXOR):
+            # a known side (constant, or donor-evaluable — verified by
+            # the final whole-formula evaluation) fixes the other's bits
+            for c, other in (t.args, tuple(reversed(t.args))):
+                cv = c.val if c.op == T.BV_CONST else self._ev(c)
+                if not isinstance(cv, int):
+                    continue
+                saved = dict(self.reqs)
+                if op == T.BAND:
+                    if val & ~cv:
+                        ok = False  # need a 1 where the AND forces 0
+                    else:
+                        ok = self.force(other, mask & cv, val)
+                elif op == T.BOR:
+                    if ~val & mask & cv:
+                        ok = False  # need a 0 where the OR forces 1
+                    else:
+                        ok = self.force(other, mask & ~cv, val & ~cv)
+                else:
+                    ok = self.force(other, mask, val ^ (cv & mask))
+                if ok:
+                    return True
+                self.reqs = saved
             return False
-        if op == T.BOR:
-            for c, other in (t.args, reversed(t.args)):
-                if c.op == T.BV_CONST:
-                    if ~val & mask & c.val:
-                        return False  # need a 0 where the OR forces 1
-                    return self.force(other, mask & ~c.val, val & ~c.val)
+        if op in (T.ADD, T.SUB, T.MUL, T.NEG):
+            # modular arithmetic is invertible on low-contiguous masks
+            # (carries only travel upward) — the shape every overflow
+            # check has (full 256-bit equality/bound on a sum/product)
+            if mask & (mask + 1):
+                return False
+            modm = mask  # mask == 2^k - 1
+            if op == T.NEG:
+                return self.force(t.args[0], mask, -val & modm)
+            a, b = t.args
+            for x, y, x_is_left in ((a, b, True), (b, a, False)):
+                cv = y.val if y.op == T.BV_CONST else self._ev(y)
+                if not isinstance(cv, int):
+                    continue
+                if op == T.ADD:
+                    tgt = (val - cv) & modm
+                elif op == T.SUB:
+                    tgt = (val + cv) & modm if x_is_left else (cv - val) & modm
+                else:  # MUL: invertible only by an odd factor
+                    if not cv & 1:
+                        continue
+                    tgt = (val * pow(cv, -1, modm + 1)) & modm
+                saved = dict(self.reqs)
+                if self.force(x, mask, tgt):
+                    return True
+                self.reqs = saved
             return False
-        if op == T.BXOR:
-            for c, other in (t.args, reversed(t.args)):
-                if c.op == T.BV_CONST:
-                    return self.force(other, mask, val ^ (c.val & mask))
+        if op == T.SEXT:
+            inner = t.args[0]
+            iw = inner.width
+            im = _mask(iw)
+            ext_req = mask >> iw  # requested bits in the extension
+            m2, v2 = mask & im, val & im
+            if ext_req:
+                ebits = val >> iw
+                if ebits not in (0, ext_req):
+                    return False  # extension bits must replicate the sign
+                sbit = 1 << (iw - 1)
+                if m2 & sbit and bool(v2 & sbit) != bool(ebits):
+                    return False
+                m2 |= sbit
+                v2 = (v2 & ~sbit) | (sbit if ebits else 0)
+            return self.force(inner, m2, v2)
+        if op == T.UREM:
+            # x % c == val: pick the simplest preimage, x = val itself
+            d = t.args[1]
+            dv = d.val if d.op == T.BV_CONST else self._ev(d)
+            if mask == _mask(t.width) and isinstance(dv, int) and 0 <= val < dv:
+                return self.force(t.args[0], mask, val)
             return False
         if op == T.BNOT:
             return self.force(t.args[0], mask, ~val & mask)
@@ -353,6 +430,11 @@ def try_repair(constraint_term: "T.Term", model) -> Optional[Model]:
             nd.bv[key[1]] = (cur & ~mask) | val
         elif kind == "bool":
             nd.bools[key[1]] = bool(val)
+        elif kind == "func":
+            _, name, argv = key
+            table = nd.funcs.setdefault(name, {})
+            cur = table.get(argv, 0)
+            table[argv] = (cur & ~mask) | val
         else:
             _, name, idx = key
             default, entries = nd.arrays.setdefault(name, (0, {}))

@@ -155,16 +155,34 @@ def _congruence_axioms(x, fresh, select_map, apply_map):
             axioms.append(T.mk_eq(fresh, base.args[0]))
             return axioms, None
         name = base.name
+        idx1 = x.args[1]
         for (idx2, var2) in select_map.get(name, ()):
+            # two DISTINCT constant indices make the congruence axiom
+            # vacuously true — and constant indices are the common case
+            # (calldata words, storage slots), so skipping them turns
+            # the quadratic axiom set into pairs touching a symbolic
+            # index only (an identical constant hits the instance cache
+            # and never reaches here)
+            if (
+                idx1.op == T.BV_CONST
+                and idx2.op == T.BV_CONST
+                and idx1.val != idx2.val
+            ):
+                continue
             axioms.append(
                 T.mk_bool_or(
-                    T.mk_not(T.mk_eq(x.args[1], idx2)),
+                    T.mk_not(T.mk_eq(idx1, idx2)),
                     T.mk_eq(fresh, var2),
                 )
             )
-        return axioms, (select_map, name, (x.args[1], fresh))
+        return axioms, (select_map, name, (idx1, fresh))
     name = x.name
     for (args2, var2) in apply_map.get(name, ()):
+        if any(
+            a1.op == T.BV_CONST and a2.op == T.BV_CONST and a1.val != a2.val
+            for a1, a2 in zip(x.args, args2)
+        ):
+            continue  # distinct constant argument: vacuous congruence
         hyp = [
             T.mk_not(T.mk_eq(a1, a2))
             for a1, a2 in zip(x.args, args2)

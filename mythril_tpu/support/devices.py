@@ -57,6 +57,30 @@ def default_tpu_lanes() -> int:
     return 64
 
 
+#: result of the one-time device-execution probe (None = not yet run)
+_DEVICE_EXEC_OK = None
+
+
+def device_exec_ok() -> bool:
+    """Probe device usability with an actual executed op, ONCE per
+    process: device *enumeration* can succeed while execution is broken
+    (e.g. a libtpu client/terminal version mismatch fails only at the
+    first executed primitive).  Cached — on a tunneled backend even a
+    trivial scalar op costs a ~0.5 s XLA compile, which used to land
+    inside every analysis wall."""
+    global _DEVICE_EXEC_OK
+    if _DEVICE_EXEC_OK is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jnp.zeros((), jnp.int32) + 1)
+            _DEVICE_EXEC_OK = True
+        except Exception:
+            _DEVICE_EXEC_OK = False
+    return _DEVICE_EXEC_OK
+
+
 def effective_tpu_lanes() -> int:
     """args.tpu_lanes with the auto sentinel (<0) resolved — and cached
     back onto the run context so every later reader sees the same
@@ -110,6 +134,10 @@ def force_virtual_cpu(n_devices: int) -> None:
         xb._clear_backends()
         if hasattr(xb.get_backend, "cache_clear"):
             xb.get_backend.cache_clear()
+    # the rebuilt backend must be re-probed: a False cached against the
+    # torn-down backend would otherwise disable device paths forever
+    global _DEVICE_EXEC_OK
+    _DEVICE_EXEC_OK = None
 
     # XLA_FLAGS is parsed once per process, so it only helps when no
     # client was ever created; jax_num_cpu_devices covers re-init after
@@ -146,10 +174,7 @@ def ensure_devices(n_devices: int) -> None:
     import jax
 
     try:
-        if len(jax.devices()) >= n_devices:
-            import jax.numpy as jnp
-
-            jax.block_until_ready(jnp.zeros(()) + 1)
+        if len(jax.devices()) >= n_devices and device_exec_ok():
             return
     except Exception:
         pass  # unusable device plugin — fall through to virtual CPU

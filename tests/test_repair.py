@@ -131,3 +131,66 @@ def test_storm_avoids_cdcl(monkeypatch):
             assert m.raw[0].eval_term((w & 1).raw) == (leaf >> i) & 1
     assert repair.STATS["repaired"] >= 60
     assert calls["n"] <= 4  # the seed solve plus stragglers at most
+
+
+def test_overflow_literal_over_balance_read():
+    """The arithmetic-overflow witness shape: balances[keccak-ish key]
+    + amount wraps past 2**256 — ULT(a+b, a) with a = SELECT over a
+    symbolic index.  The donor satisfies the path but not the overflow;
+    the forcer must invert the ADD and pin the balance cell."""
+    from mythril_tpu.smt import terms as T
+
+    bal = T.array_var("balances", 256, 256)
+    key = _bv("key")
+    amount = _bv("amount")
+    read = T.mk_select(bal, key.raw)
+    total = T.mk_add(read, amount.raw)
+    donor = _model({"key": 5, "amount": 10},
+                   arrays={"balances": (0, {5: 100})})
+    fixed = repair.try_repair(T.mk_ult(total, read), donor)
+    assert fixed is not None
+    md = fixed.raw[0]
+    a = md.eval_term(read)
+    s = md.eval_term(total)
+    assert s < a  # genuinely wrapped
+
+
+def test_sub_and_mul_inversion():
+    x, y = _bv("x"), _bv("y")
+    donor = _model({"x": 50, "y": 3})
+    # x - y == 100 with y known: force x = 103
+    fixed = repair.try_repair((x - y == _c(100)).raw, donor)
+    assert fixed is not None
+    assert (fixed.raw[0].bv["x"] - fixed.raw[0].bv["y"]) % (1 << 256) == 100
+    # 3 * x == 99 via modular inverse of the odd factor
+    donor = _model({"x": 1})
+    fixed = repair.try_repair((x * _c(3) == _c(99)).raw, donor)
+    assert fixed is not None
+    assert (fixed.raw[0].bv["x"] * 3) % (1 << 256) == 99
+
+
+def test_apply_cell_patch():
+    """A UF application (keccak placeholder shape) with donor-evaluable
+    args gets its table entry pinned."""
+    from mythril_tpu.smt import terms as T
+
+    x = _bv("x")
+    app = T.apply_func(("keccak512", (256,), 256), x.raw)
+    donor = _model({"x": 7})
+    term = T.mk_eq(app, _c(0xBEEF).raw)
+    fixed = repair.try_repair(term, donor)
+    assert fixed is not None
+    assert fixed.raw[0].funcs["keccak512"][(7,)] == 0xBEEF
+
+
+def test_sext_forcing():
+    from mythril_tpu.smt import terms as T
+
+    w8 = symbol_factory.BitVecSym("b", 8)
+    ext = T.mk_sext(248, w8.raw)
+    donor = _model({"b": 0})
+    # force a negative value through the sign extension
+    target = (-5) % (1 << 256)
+    fixed = repair.try_repair(T.mk_eq(ext, T.bv_const(target, 256)), donor)
+    assert fixed is not None
+    assert fixed.raw[0].bv["b"] == (-5) % 256
