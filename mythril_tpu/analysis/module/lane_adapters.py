@@ -206,39 +206,47 @@ class IntegerAdapter(LaneAdapter):
 
 
 class ArbitraryStorageAdapter(LaneAdapter):
-    """Concrete-key device SSTOREs: the module's probe constraint
-    `key == 324345425435` is unsatisfiable unless the contract
-    literally writes that slot — a documented, astronomically-unlikely
-    deviation (PARITY.md). SYMBOLIC-key SSTOREs (the actual
-    arbitrary-write shape, executed on device by symbolic-storage
-    mode) run the real module against the reconstructed pre-SSTORE
-    site state; its PotentialIssues ride the promotion channel onto
-    every descendant state (interpreter parity: each path through the
-    SSTORE carries one) and discharge at transaction end as usual."""
+    """SYMBOLIC-key SSTOREs (the actual arbitrary-write shape,
+    executed on device by symbolic-storage mode) run the real module
+    against the reconstructed pre-SSTORE site state; its
+    PotentialIssues ride the promotion channel onto every descendant
+    state (interpreter parity: each path through the SSTORE carries
+    one) and discharge at transaction end as usual.
+
+    CONCRETE-key device SSTOREs: the module's probe constraint is
+    `key == 324345425435` (ref arbitrary_write.py:21-28), which for a
+    concrete key is decidable by comparison — equal runs the module
+    (host parity even for the adversarial contract that literally
+    writes the sentinel slot), different skips the provably-UNSAT
+    PotentialIssue without paying the discharge query the host pays."""
 
     lifted_hooks = frozenset({"SSTORE"})
-    _logged_deviation = False
+    #: the stepper's probe-key sink record (symstep key_is_probe) is
+    #: gated on taint_table[SSTORE] — this adapter must set that bit
+    #: itself, not rely on the integer adapter being co-loaded
+    taint_ops = frozenset({"SSTORE"})
+
+    #: the module's probe slot (single source:
+    #: support/eth_constants.py; the device stepper mints a sink
+    #: record for a concrete write to it)
+    from ...support.eth_constants import ARB_PROBE_SLOT as PROBE_SLOT
 
     def on_sstore(self, value, site, key=None):
-        if key is not None and getattr(key, "value", 0) is None:
-            from ..potential_issues import (
-                get_potential_issues_annotation,
-            )
+        if key is not None:
+            kv = getattr(key, "value", None)
+            if kv is None or kv == self.PROBE_SLOT:
+                from ..potential_issues import (
+                    get_potential_issues_annotation,
+                )
 
-            # pre-SSTORE stack tail: [-2]=value, [-1]=write slot
-            site.stack_tail = (value, key)
-            state = site.build_state()
-            self.module.execute(state)
-            return list(
-                get_potential_issues_annotation(state).potential_issues
-            )
-        if not ArbitraryStorageAdapter._logged_deviation:
-            ArbitraryStorageAdapter._logged_deviation = True
-            log.info(
-                "lane-mode deviation active: ArbitraryStorage probes "
-                "device-executed concrete-key SSTOREs with an "
-                "unsatisfiable constraint (host parity except a "
-                "contract writing slot 324345425435; see PARITY.md)")
+                # pre-SSTORE stack tail: [-2]=value, [-1]=write slot
+                site.stack_tail = (value, key)
+                state = site.build_state()
+                self.module.execute(state)
+                return list(
+                    get_potential_issues_annotation(
+                        state).potential_issues
+                )
         return super().on_sstore(value, site, key)
 
     def attach(self, gs, promotions, last_jump):

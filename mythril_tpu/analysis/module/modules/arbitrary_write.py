@@ -30,10 +30,14 @@ class ArbitraryStorage(DetectionModule):
         annotation.potential_issues.extend(potential_issues)
 
     def _analyze_state(self, state):
+        from ....support.eth_constants import ARB_PROBE_SLOT
+
         write_slot = state.mstate.stack[-1]
-        # a write is arbitrary if the slot can equal a random probe value
+        # a write is arbitrary if the slot can equal a random probe
+        # value (single source: support/eth_constants.py; the device
+        # stepper mints a sink record for a concrete write to it)
         constraints = state.world_state.constraints + [
-            write_slot == symbol_factory.BitVecVal(324345425435, 256)
+            write_slot == symbol_factory.BitVecVal(ARB_PROBE_SLOT, 256)
         ]
         potential_issue = PotentialIssue(
             contract=state.environment.active_account.contract_name,

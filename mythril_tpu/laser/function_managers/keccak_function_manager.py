@@ -97,6 +97,10 @@ class KeccakFunctionManager:
             = {}
         #: (input tid, same-width concrete count) -> cached axiom term
         self._axiom_cache: Dict[Tuple[int, int], Bool] = {}
+        #: create_conditions memo — the population-count key is only
+        #: valid within one manager lifetime (slabs re-allocate after
+        #: reset), so __init__ must drop it explicitly
+        self._conditions_cache = None
 
     def reset(self):
         self.__init__()
@@ -201,7 +205,23 @@ class KeccakFunctionManager:
     def create_conditions(self) -> Bool:
         """The conjunction of every axiom this run's hashes need —
         appended to each solver query by Constraints.get_all_constraints
-        (laser/state/constraints.py)."""
+        (laser/state/constraints.py). Memoized on the manager's hash
+        population: terminal storms call this once per open state
+        (16k+ times a run) while the population changes only when a
+        new hash appears."""
+        key = (
+            tuple((w, len(m.symbolic_inputs))
+                  for w, m in self._widths.items()),
+            len(self.concrete_hashes),
+        )
+        cached = getattr(self, "_conditions_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        out = self._create_conditions_uncached()
+        self._conditions_cache = (key, out)
+        return out
+
+    def _create_conditions_uncached(self) -> Bool:
         parts: List[Bool] = []
         for model in self._widths.values():
             parts.extend(self._axiom_for(data)

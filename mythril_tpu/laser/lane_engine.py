@@ -26,9 +26,11 @@ Provisional (negative) sids minted on device encode (lane, record-slot)
 and are rewritten to table ids at each drain.
 """
 
+import atexit
 import functools
 import logging
 import os
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -1043,7 +1045,18 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
         jax.block_until_ready(_gather_full_flog(st))
         ridx = jnp.full(_geo_bucket(1, n_lanes, min(64, n_lanes)),
                         n_lanes, jnp.int32)
-        st, rows = _retire_rows(st, ridx, 8, 64, 8, 8)
+        if _tunneled_backend():
+            # the production retire on this backend always runs at the
+            # plane caps (see _retire_floors) — warm that exact variant
+            lk = lane_kwargs
+            st, rows = _retire_rows(
+                st, ridx,
+                lk.get("stack_depth", 64),
+                lk.get("memory_bytes", 4096),
+                lk.get("mem_records", 64),
+                lk.get("storage_slots", 64))
+        else:
+            st, rows = _retire_rows(st, ridx, 8, 64, 8, 8)
         jax.block_until_ready(rows)
     eng._release_state(st)
 
@@ -1097,17 +1110,45 @@ def warm_variant(n_lanes: int, code_len: int, lane_kwargs: dict,
         def _worker():
             while True:
                 with _WARM_LOCK:
-                    if not queue:
+                    if not queue or _WARM_SHUTDOWN.is_set():
                         _WARM["_worker"] = "idle"
                         return
                     fn = queue.pop(0)
                 fn()
 
+        # NON-daemon, deliberately: a daemon thread still inside XLA
+        # C++ at interpreter finalization gets pthread_exit()ed on its
+        # next GIL acquisition, and the forced unwind crossing XLA's
+        # catch(...) blocks calls std::terminate ("FATAL: exception
+        # not rethrown", SIGABRT after all results were printed —
+        # root-caused round 5, reproducible on the CPU backend too).
+        # threading joins non-daemon threads BEFORE finalization, so
+        # exit waits for at most the in-flight compile; the atexit
+        # hook below drops everything still queued.
         threading.Thread(target=_worker, name="lane-warmup",
-                         daemon=True).start()
+                         daemon=False).start()
         return False
     _compile()
     return True
+
+
+_WARM_SHUTDOWN = threading.Event()
+
+
+def _drain_warm_queue_at_exit() -> None:
+    """Stop the background warm worker picking up NEW compiles once
+    interpreter shutdown begins (an in-flight compile finishes and is
+    waited for by threading's non-daemon join)."""
+    _WARM_SHUTDOWN.set()
+    if _WARM_LOCK is None:
+        return
+    with _WARM_LOCK:
+        q = _WARM.get("_queue")
+        if q:
+            del q[:]
+
+
+atexit.register(_drain_warm_queue_at_exit)
 
 
 # ops whose alu resolver takes pop-coerced bitvec args, keyed by arity
@@ -1284,7 +1325,7 @@ class LaneEngine:
         self.stats = {
             "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
             "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
-            "resumed": 0,
+            "resumed": 0, "overlap_mat": 0, "overlap_mat_ms": 0,
         }
         # in-place SHA3 resume: off whenever a detector hooks SHA3
         # (the hook must fire host-side; no adapter lifts SHA3 today)
@@ -2163,6 +2204,24 @@ class LaneEngine:
         resumes: List[tuple] = []
         small = min(16, self.n_lanes)
         peak_demand = len(queue)
+        # one-deep materialization pipeline: GlobalState rebuilds for
+        # window k's retired lanes run AFTER window k+1 is dispatched,
+        # overlapping the host's biggest per-window cost with device
+        # execution. Flushed before window k+1's drain — materialize
+        # resolves this window's provisional sids through self._prov,
+        # which the next drain overwrites.
+        pending_mat: List[tuple] = []
+
+        def _flush_pending() -> None:
+            if not pending_mat:
+                return
+            t0 = time.perf_counter()
+            for rows_host, row, ctx in pending_mat:
+                results.append(self.materialize(rows_host, row, ctx))
+            self.stats["overlap_mat"] += len(pending_mat)
+            self.stats["overlap_mat_ms"] += int(
+                (time.perf_counter() - t0) * 1000)
+            pending_mat.clear()
         try:
             while True:
                 # a seed backlog beyond the small bucket drains in ONE
@@ -2205,10 +2264,13 @@ class LaneEngine:
                     ctxs[lane] = None
                     free.append(lane)
                 kill = []
+                # the dispatch above is asynchronous: rebuild the LAST
+                # window's retired GlobalStates while this one executes
+                _flush_pending()
                 if PROF_ON:
                     PROF.setdefault("windows", []).append(  # type: ignore
                         (round(time.perf_counter() - _tw, 3), k,
-                         len(code_bytes)))
+                         len(code_bytes), self.n_lanes))
                 self.stats["windows"] += 1
                 with _prof("window_pull"):
                     (misc, scal, utab, ftab, ridx, r_i32, r_u32,
@@ -2303,9 +2365,27 @@ class LaneEngine:
                 # window's records and forks — the two biggest
                 # per-window costs overlap instead of serializing
                 def _retire_floors(lanes_sel):
+                    lk = self.lane_kwargs
+                    if _tunneled_backend() and len(lanes_sel) <= 256:
+                        # content-adaptive floors minimize transfer, but
+                        # every new floor combo is a distinct static
+                        # shape = a fresh multi-second XLA compile over
+                        # the tunnel, where the transfer saved is noise
+                        # next to the fixed RTT — for SMALL retire sets.
+                        # Retire those at the plane caps: ONE variant,
+                        # compiled at warm-up. Large terminal waves
+                        # (thousands of rows) flip the tradeoff: full
+                        # caps would ship ~7 KB/row where the geometric
+                        # floors ship ~1 KB, and one compile amortizes
+                        # over the whole wave.
+                        return (
+                            lk.get("stack_depth", 64),
+                            lk.get("memory_bytes", 4096),
+                            lk.get("mem_records", 64),
+                            lk.get("storage_slots", 64),
+                        )
                     c = counts_h
                     sel = np.asarray(lanes_sel, np.int32)
-                    lk = self.lane_kwargs
                     return (
                         _geo_bucket(max(int(c["sp"][sel].max()), 1),
                                     lk.get("stack_depth", 64), 8),
@@ -2325,14 +2405,19 @@ class LaneEngine:
                     idx_arr[: len(lanes_sel)] = lanes_sel
                     return idx_arr
 
-                def _materialize_rows(lanes_sel, rows_host):
+                def _materialize_rows(lanes_sel, rows_host,
+                                      defer=False):
                     with _prof("materialize"):
                         for row, lane in enumerate(lanes_sel):
                             self.stats["device_steps"] += \
                                 int(steps[lane])
                             if lane not in dead_set:
-                                results.append(self.materialize(
-                                    rows_host, row, ctxs[lane]))
+                                if defer:
+                                    pending_mat.append(
+                                        (rows_host, row, ctxs[lane]))
+                                else:
+                                    results.append(self.materialize(
+                                        rows_host, row, ctxs[lane]))
                             ctxs[lane] = None
                             free.append(lane)
                     status[np.asarray(lanes_sel, np.int32)] = DEAD
@@ -2385,15 +2470,15 @@ class LaneEngine:
                         for row, lane in enumerate(fast):
                             self.stats["device_steps"] += int(steps[lane])
                             if lane not in dead_set:
-                                results.append(self.materialize(
-                                    st_fast, row, ctxs[lane]))
+                                pending_mat.append(
+                                    (st_fast, row, ctxs[lane]))
                             ctxs[lane] = None
                             free.append(lane)
                 if rest:
                     with _prof("retire_pull"):
                         st_host = _unpack_rows(jax.device_get(rows),
                                                *floors)
-                    _materialize_rows(rest, st_host)
+                    _materialize_rows(rest, st_host, defer=True)
                 if declined:
                     # rare: held lanes the host would not resume
                     # (symbolic length, OOG, oversize, trivially-false
@@ -2424,6 +2509,10 @@ class LaneEngine:
                 running = int(np.sum(status == Status.RUNNING))
                 if not running and not queue:
                     break
+            # the last window has no successor dispatch to hide behind
+            for rows_host, row, ctx in pending_mat:
+                results.append(self.materialize(rows_host, row, ctx))
+            pending_mat.clear()
         finally:
             # an exception mid-sweep (svm falls back to the host)
             # must not lose coverage accumulated in prior windows;

@@ -75,6 +75,13 @@ def get_lib() -> ctypes.CDLL:
         lib.mtpu_sat_solve.restype = ctypes.c_int32
         lib.mtpu_sat_value.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.mtpu_sat_value.restype = ctypes.c_int32
+        if hasattr(lib, "mtpu_sat_core"):
+            lib.mtpu_sat_core.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
+            lib.mtpu_sat_core.restype = ctypes.c_int32
         try:
             lib.mtpu_sat_assignment.argtypes = [
                 ctypes.c_void_p,
@@ -239,6 +246,20 @@ class SatSolver:
 
     def value(self, var: int) -> bool:
         return self._lib.mtpu_sat_value(self._h, var) == 1
+
+    def core(self):
+        """Failed-assumption core of the last unsat solve: the subset
+        of the assumption literals the clause set refutes (empty =
+        refuted with no assumptions). [] on a stale library."""
+        if not hasattr(self._lib, "mtpu_sat_core"):
+            return []
+        cap = 256
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.mtpu_sat_core(self._h, buf, cap)
+            if n <= cap:
+                return list(buf[:n])
+            cap = n
 
     def assignment_snapshot(self):
         """The full current assignment as one int8 buffer (index 0 =

@@ -533,6 +533,65 @@ struct Solver {
     return std::pow(y, seq);
   }
 
+  // Failed-assumption core of the last UNSAT-under-assumptions result
+  // (MiniSat analyzeFinal): the subset of the query's assumption
+  // literals the permanent clauses refute. Any later query whose
+  // assumption set contains a recorded core is unsat without search —
+  // the incremental session caches cores for exactly that subsumption
+  // test. Empty after a level-0 (assumption-free) refutation.
+  std::vector<Lit> core;
+
+  // Walk the implication graph from a seed (conflict clause or a
+  // falsified assumption) back to decision literals. While solve() is
+  // establishing assumptions, every decision level IS an assumption,
+  // so the collected decisions are precisely the core.
+  void final_core_walk() {
+    for (int i = (int)trail.size() - 1; i >= 0; --i) {
+      Var v = var_of(trail[i]);
+      if (!seen[v]) continue;
+      seen[v] = 0;
+      if (reason[v] == CREF_NONE) {
+        core.push_back(trail[i]);
+      } else {
+        int rsz;
+        const Lit* rl = ref_lits(reason[v], trail[i], rsz);
+        for (int j = 0; j < rsz; ++j) {
+          Var u = var_of(rl[j]);
+          if (u != v && level[u] > 0) seen[u] = 1;
+        }
+      }
+    }
+  }
+
+  void analyze_final_clause(int confl) {
+    core.clear();
+    seen.assign(assign.size(), 0);
+    int sz;
+    const Lit* cl;
+    if (confl == CREF_BIN) {
+      cl = bin_confl;
+      sz = 2;
+    } else {
+      sz = (int)clauses[confl].size;
+      cl = lits(confl);
+    }
+    for (int i = 0; i < sz; ++i) {
+      Var v = var_of(cl[i]);
+      if (level[v] > 0) seen[v] = 1;
+    }
+    final_core_walk();
+  }
+
+  void analyze_final_lit(Lit a) {
+    core.clear();
+    core.push_back(a);  // the assumption that failed to establish
+    Var av = var_of(a);
+    if (level[av] == 0) return;  // refuted by level-0 units alone
+    seen.assign(assign.size(), 0);
+    seen[av] = 1;
+    final_core_walk();
+  }
+
   // returns: 1 sat, 0 unsat, -1 unknown (budget exhausted)
   // true iff the trail's propagation closure is complete (only a SAT
   // exit guarantees it; conflict bails fast-forward qhead past pending
@@ -581,9 +640,13 @@ struct Solver {
         // state must not be reused for further queries.
         if (trail_lim.empty()) {
           ok = false;
+          core.clear();  // refuted with no assumptions: empty core
           return 0;
         }
-        if ((int)trail_lim.size() <= (int)assumptions.size()) return 0;
+        if ((int)trail_lim.size() <= (int)assumptions.size()) {
+          analyze_final_clause(confl);
+          return 0;
+        }
         int btlevel;
         uint32_t lbd;
         analyze(confl, learnt_cl, btlevel, lbd);
@@ -626,7 +689,10 @@ struct Solver {
         // establish assumptions (one decision level each), then decide
         if ((int)trail_lim.size() < (int)assumptions.size()) {
           Lit a = assumptions[trail_lim.size()];
-          if (value(a) == F) return 0;  // assumptions conflict
+          if (value(a) == F) {  // assumptions conflict
+            analyze_final_lit(a);
+            return 0;
+          }
           trail_lim.push_back((int)trail.size());
           if (value(a) == U) uncheck_enqueue(a, -1);
           continue;
@@ -711,6 +777,18 @@ int32_t mtpu_sat_solve(void* sp, const int32_t* assumps, int32_t n,
   }
   int r = s->solve(internal.data(), n, timeout_s, conflict_budget);
   return r;
+}
+// Failed-assumption core of the last UNSAT-under-assumptions solve, in
+// DIMACS form matching the literals passed as assumptions. Returns the
+// core size (may exceed cap; only min(n, cap) entries are written).
+int32_t mtpu_sat_core(void* sp, int32_t* out, int32_t cap) {
+  Solver* s = (Solver*)sp;
+  int n = (int)s->core.size();
+  for (int i = 0; i < n && i < cap; ++i) {
+    Lit l = s->core[i];
+    out[i] = (var_of(l) + 1) * (sign_of(l) ? -1 : 1);
+  }
+  return n;
 }
 // model value of DIMACS var v (>=1): 1 true, 0 false, -1 unassigned
 int32_t mtpu_sat_value(void* sp, int32_t v) {

@@ -396,6 +396,15 @@ def _build_mstore_pattern_masks():
 
 MSTORE_PAT_MASK, MSTORE_PAT_EXPECT = _build_mstore_pattern_masks()
 
+# ArbitraryStorage probe slot: a concrete-key SSTORE to it must mint a
+# sink record even though nothing is symbolic (the one concrete key the
+# module's probe constraint can satisfy).
+from ..support.eth_constants import ARB_PROBE_SLOT  # noqa: E402
+
+_ARB_PROBE_LIMBS = np.array(
+    [(ARB_PROBE_SLOT >> (32 * i)) & 0xFFFFFFFF for i in range(8)],
+    np.uint32)
+
 
 def sym_step(code: CompiledCode, st: SymLaneState,
              exec_table: jnp.ndarray = None,
@@ -563,8 +572,13 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
     # SSTORE of a symbolic value leaves a sink record so taint promotion
     # (integer module JUMPI/SSTORE sinks) sees every store, not just the
-    # final storage contents
-    sink_want = is_sstore & taint_op & (sid_b != 0)
+    # final storage contents. An all-concrete SSTORE whose key IS the
+    # ArbitraryStorage probe slot also records: it is the one concrete
+    # key the module's probe constraint can satisfy, and without a
+    # record the drain would never see the write (adversarial
+    # sentinel-writer parity).
+    key_is_probe = jnp.all(a == jnp.asarray(_ARB_PROBE_LIMBS), axis=-1)
+    sink_want = is_sstore & taint_op & ((sid_b != 0) | key_is_probe)
 
     # concrete MSTORE matching the user-assertions 0xcafe… pattern parks
     # (the module fires its issue at the MSTORE site host-side)

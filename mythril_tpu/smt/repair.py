@@ -50,7 +50,56 @@ REPAIR_MODELS = 4
 MAX_FAILED = 48
 
 #: repair effectiveness counters (read by bench detail)
-STATS = {"attempts": 0, "repaired": 0}
+STATS = {"attempts": 0, "repaired": 0,
+         "verify_skipped": 0, "verify_evaled": 0}
+
+#: conjunct tid -> frozenset of read-cell keys, or None when the term
+#: contains structure the extractor does not model (always re-verify).
+#: Keys: ("bv", name) | ("bool", name) | ("arr", name, idx) for a
+#: constant-index select | ("arr*", name) for any other read of the
+#: array | ("func", name).
+_CELLS_CACHE: Dict[int, Optional[frozenset]] = {}
+
+
+def _read_cells(t: "T.Term") -> Optional[frozenset]:
+    """Every model cell `t`'s value can depend on. Exact at the leaf
+    level: eval_term reads only variable/array/function leaves, so two
+    models agreeing on these cells give `t` the same value."""
+    cached = _CELLS_CACHE.get(t.tid, False)
+    if cached is not False:
+        return cached
+    cells = set()
+    stack = [t]
+    seen = set()
+    while stack:
+        cur = stack.pop()
+        if cur.tid in seen:
+            continue
+        seen.add(cur.tid)
+        op = cur.op
+        if op == T.BV_VAR:
+            cells.add(("bv", cur.name))
+        elif op == T.BOOL_VAR:
+            cells.add(("bool", cur.name))
+        elif op == T.APPLY:
+            cells.add(("func", cur.name))
+            stack.extend(cur.args)
+        elif op == T.SELECT:
+            arr, idx = cur.args
+            if arr.op == T.ARRAY_VAR and idx.op == T.BV_CONST:
+                cells.add(("arr", arr.name, idx.val))
+                continue  # both children accounted for
+            # symbolic index / store chain: the walk below adds a
+            # whole-array marker at each ARRAY_VAR leaf and collects
+            # the index's and stored values' own cells
+            stack.extend(cur.args)
+        elif op == T.ARRAY_VAR:
+            cells.add(("arr*", cur.name))
+        else:
+            stack.extend(cur.args)
+    out = frozenset(cells)
+    _CELLS_CACHE[t.tid] = out
+    return out
 
 _Cell = Tuple  # ("bv", name) | ("arr", name, idx) | ("bool", name)
 #              | ("func", name, argvals)
@@ -395,6 +444,7 @@ def try_repair(constraint_term: "T.Term", model) -> Optional[Model]:
     STATS["attempts"] += 1
     rep = _Repairer(md)
     failed = 0
+    scan: list = []
     for c in conjuncts:
         try:
             r = md.eval_term(c, complete=False)
@@ -402,6 +452,7 @@ def try_repair(constraint_term: "T.Term", model) -> Optional[Model]:
             r = None  # unbound symbol: the repair may bind it
         except Exception:
             return None
+        scan.append(r)
         if r is True:
             continue
         failed += 1
@@ -443,10 +494,33 @@ def try_repair(constraint_term: "T.Term", model) -> Optional[Model]:
 
     # the authority: the patched assignment must satisfy the WHOLE
     # formula under evaluation (complete=True matches what the CDCL
-    # core returns — don't-care symbols default like an omitted decl)
+    # core returns — don't-care symbols default like an omitted decl).
+    # CELL-SCOPED: a conjunct that evaluated True under the donor and
+    # whose read-cell set is disjoint from the patched cells has the
+    # SAME value under the patch (evaluation depends only on leaf
+    # cells) — only intersecting or previously-unresolved conjuncts
+    # re-evaluate. On sibling terminal storms this turns the full-DAG
+    # verification walk into a handful of literal evaluations.
+    patch_keys = set()
+    for key in rep.reqs:
+        kind = key[0]
+        if kind == "arr":
+            patch_keys.add(key)
+            patch_keys.add(("arr*", key[1]))
+        elif kind == "func":
+            patch_keys.add(("func", key[1]))
+        else:
+            patch_keys.add((kind, key[1]))
     try:
-        if nd.eval_term(constraint_term, complete=True) is not True:
-            return None
+        for c, r in zip(conjuncts, scan):
+            if r is True:
+                cells = _read_cells(c)
+                if cells is not None and cells.isdisjoint(patch_keys):
+                    STATS["verify_skipped"] += 1
+                    continue
+            STATS["verify_evaled"] += 1
+            if nd.eval_term(c, complete=True) is not True:
+                return None
     except Exception:
         return None
     STATS["repaired"] += 1

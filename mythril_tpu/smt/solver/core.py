@@ -55,8 +55,12 @@ class ModelData:
         return env
 
     #: persistent-memo size bound: STORE nodes memoize dict snapshots,
-    #: so an unbounded memo grows quadratically on deep storage chains
-    _MEMO_CAP = 100_000
+    #: so an unbounded memo grows quadratically on deep storage chains.
+    #: Sized so a 64k-path terminal storm's shared-prefix DAG stays
+    #: memoized across the whole quick-sat scan (~80 B/entry → ~160 MB
+    #: at the cap); a 100k cap thrashed and made sibling evaluation
+    #: quadratic (re-walking the shared prefix per open state)
+    _MEMO_CAP = 2_000_000
 
     def eval_term(self, t: "T.Term", complete: bool = True):
         # persistent per-model memo: terms are hash-consed process-wide
@@ -291,6 +295,13 @@ class _IncrementalSession:
         self._dirty = False
         # constraint tid -> (root lit, ackermann-expanded term)
         self._prepared: Dict[int, tuple] = {}
+        # failed-assumption cores of past UNSAT answers: clauses only
+        # ever accumulate in a session, so a query whose assumption set
+        # contains a recorded core is unsat without touching the solver
+        # (detector storms re-refute near-identical systems otherwise —
+        # 24 attacker-profit checks on one corpus contract cost 27 s of
+        # CDCL before this, ~1 s after)
+        self.unsat_cores: List[frozenset] = []
 
     def prepare(self, work: List["T.Term"]) -> Tuple[List[int], list]:
         """(assumption literals, expanded terms) for a constraint list,
@@ -365,6 +376,10 @@ class _IncrementalSession:
 
 _session: Optional[_IncrementalSession] = None
 _SESSION_VAR_LIMIT = 3_000_000
+_CORE_CACHE_CAP = 512
+
+#: unsat-core subsumption effectiveness (read by bench detail)
+CORE_STATS = {"cached": 0, "hits": 0}
 
 # set False to fall back to one-shot solving (fresh instance per query)
 INCREMENTAL = True
@@ -405,6 +420,13 @@ def _check_incremental(ctx, work, timeout_s, conflict_budget,
             _session = None
         raise
 
+    lit_set = frozenset(lits)
+    for core in sess.unsat_cores:
+        if core <= lit_set:
+            CORE_STATS["hits"] += 1
+            ctx.status = UNSAT
+            return ctx
+
     remaining = timeout_s - (time.monotonic() - t0)
     if remaining <= 0:
         ctx.status = UNKNOWN
@@ -416,6 +438,20 @@ def _check_incremental(ctx, work, timeout_s, conflict_budget,
         ctx.status = UNKNOWN
         return ctx
     if res is False:
+        try:
+            core = frozenset(sess.sat.core())
+        except Exception:
+            core = None
+        # a valid core is a subset of this query's assumptions; cache
+        # it for subsumption (clauses only accumulate, so it stays
+        # refuted for the life of the session). An empty core would
+        # mean the permanent clauses alone are unsat — the session is
+        # poisoned (sat.ok latched false) and must not cache anything.
+        if core and core <= lit_set:
+            if core not in sess.unsat_cores:
+                sess.unsat_cores.append(core)
+                CORE_STATS["cached"] += 1
+                del sess.unsat_cores[:-_CORE_CACHE_CAP]
         ctx.status = UNSAT
         return ctx
 

@@ -33,3 +33,14 @@ FRAME_GAS_LIMIT = 1_000_000_000
 
 def ceil32(x: int) -> int:
     return x if x % 32 == 0 else x + 32 - (x % 32)
+
+# -- detector constants (not protocol constants, but they must be
+# shared dependency-free between the analysis layer and the device
+# stepper) ------------------------------------------------------------
+
+#: ArbitraryStorage probe slot (ref arbitrary_write.py:21-28): the only
+#: concrete storage key whose write the module's probe constraint can
+#: satisfy. ops/symstep.py mints a device sink record for a concrete
+#: write to it; modules/arbitrary_write.py builds the probe constraint
+#: from it; lane_adapters routes on it.
+ARB_PROBE_SLOT = 324345425435

@@ -117,8 +117,16 @@ class ModelCache:
                 # repair-served storms kept paying the full 100-model
                 # evaluation before every repair (measured 219 s of
                 # term evaluation on a 16k-path sweep); a direct scan
-                # hit still re-grows the width geometrically
-                self.model_cache.put(fixed, 1)
+                # hit still re-grows the width geometrically.
+                # Re-touch the DONOR, not the repaired model: a
+                # repaired sibling is single-use (the next path has
+                # different branch bits) and its eval memo is cold,
+                # while the donor has accumulated the shared-prefix
+                # memo — caching repairs rotated a cold-memo model to
+                # the front and made every scan re-walk the full
+                # constraint DAG (the measured top cost of a 16k-path
+                # terminal storm)
+                self.model_cache.put(model, 1)
                 self._repair_tries = REPAIR_MODELS
                 self._scan = max(self._scan // 2, self.MIN_SCAN)
                 return fixed

@@ -1,29 +1,31 @@
 """Single resolver for the vendored test corpora (tests/fixtures/ —
 see its README): every suite reads fixture DATA through these paths,
 so the tests run with no reference checkout mounted. A missing
-vendored directory (e.g. a sparse checkout) falls back to the
-reference location the data was vendored from."""
+vendored directory fails LOUDLY at import — a silent fallback to a
+reference checkout would quietly re-couple the suite to it (and pass
+on boxes where it happens to be mounted while failing everywhere
+else)."""
 
 from pathlib import Path
 
 _FIXTURES = Path(__file__).resolve().parent / "fixtures"
-_REFERENCE = Path("/root/reference/tests")
 
 
-def _resolve(vendored: Path, reference: Path) -> Path:
-    return vendored if vendored.exists() else reference
+def _resolve(vendored: Path) -> Path:
+    if not vendored.exists():
+        raise FileNotFoundError(
+            f"vendored fixture directory missing: {vendored} — "
+            "restore tests/fixtures/ (partial checkout?); the suite "
+            "deliberately does not fall back to a reference checkout"
+        )
+    return vendored
 
 
 #: solc-compiled bytecode fixtures (*.sol.o)
-INPUTS = _resolve(_FIXTURES / "testdata" / "inputs",
-                  _REFERENCE / "testdata" / "inputs")
+INPUTS = _resolve(_FIXTURES / "testdata" / "inputs")
 #: solidity sources for the solc front-end tests
-INPUT_CONTRACTS = _resolve(_FIXTURES / "testdata" / "input_contracts",
-                           _REFERENCE / "testdata" / "input_contracts")
+INPUT_CONTRACTS = _resolve(_FIXTURES / "testdata" / "input_contracts")
 #: expected easm disassembly goldens
-OUTPUTS_EXPECTED = _resolve(
-    _FIXTURES / "testdata" / "outputs_expected",
-    _REFERENCE / "testdata" / "outputs_expected")
+OUTPUTS_EXPECTED = _resolve(_FIXTURES / "testdata" / "outputs_expected")
 #: official Ethereum VMTests JSON conformance corpus
-VMTESTS = _resolve(_FIXTURES / "evm_testsuite" / "VMTests",
-                   _REFERENCE / "laser" / "evm_testsuite" / "VMTests")
+VMTESTS = _resolve(_FIXTURES / "evm_testsuite" / "VMTests")

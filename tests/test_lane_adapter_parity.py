@@ -102,3 +102,61 @@ def test_full_module_lane_parity(file_name):
         f"{file_name}: host {len(host)} issues, lane {len(lane)} issues"
     )
     assert host, f"{file_name}: expected at least one issue"
+
+
+def test_arbitrary_storage_sentinel_write_parity():
+    """Adversarial fixture: a contract that literally SSTOREs the
+    module's probe slot (324345425435) with a CONCRETE key. The device
+    executes concrete-key SSTOREs without parking, so the adapter must
+    recognize the sentinel by comparison and run the module — host and
+    lane reports must both contain the arbitrary-write issue
+    (VERDICT r4 weak #5; ref arbitrary_write.py:21-28)."""
+    from mythril_tpu.laser import lane_engine
+
+    from mythril_tpu.analysis.module.lane_adapters import (
+        ArbitraryStorageAdapter,
+    )
+
+    # PUSH1 1; PUSH5 <probe slot>; SSTORE; STOP
+    probe = ArbitraryStorageAdapter.PROBE_SLOT
+    code = "600164" + probe.to_bytes(5, "big").hex() + "5500"
+
+    def _run(tpu_lanes, modules):
+        _reset_modules()
+        disassembler = MythrilDisassembler(eth=None)
+        address, _ = disassembler.load_from_bytecode(
+            code, bin_runtime=True)
+        cmd_args = SimpleNamespace(
+            execution_timeout=600, max_depth=128, solver_timeout=25000,
+            no_onchain_data=True, loop_bound=3, create_timeout=10,
+            pruning_factor=None, unconstrained_storage=False,
+            parallel_solving=False, call_depth_limit=3,
+            disable_dependency_pruning=False,
+            custom_modules_directory="", solver_log=None,
+            transaction_sequences=None, tpu_lanes=tpu_lanes,
+        )
+        analyzer = MythrilAnalyzer(
+            disassembler=disassembler, cmd_args=cmd_args,
+            strategy="bfs", address=address)
+        try:
+            report = analyzer.fire_lasers(modules=modules,
+                                          transaction_count=1)
+        finally:
+            global_args.tpu_lanes = 0
+        out = json.loads(report.as_json())
+        return sorted(
+            (i["swc-id"], i["address"]) for i in out.get("issues") or []
+        )
+
+    # full default module set AND the module alone: the lone-module
+    # case locks the adapter's own taint_ops bit (the probe-key sink
+    # record must not depend on the integer adapter being co-loaded)
+    for modules in (None, ["ArbitraryStorage"]):
+        host = _run(0, modules)
+        lane_engine.LAST_RUN_STATS = None
+        lane = _run(16, modules)
+        stats = lane_engine.LAST_RUN_STATS
+        assert stats and stats["device_steps"] > 0, (
+            f"lane engine did not run ({modules}): {stats}")
+        assert host == lane, (modules, host, lane)
+        assert any(swc == "124" for swc, _ in host), (modules, host)

@@ -87,3 +87,59 @@ def test_sat_budget_returns_unknown():
         for p1, p2 in itertools.combinations(range(pigeons), 2):
             s.add_clause([-P[p1][h], -P[p2][h]])
     assert s.solve(conflicts=20) is None
+
+
+def test_unsat_core_extraction():
+    """Failed-assumption cores (analyzeFinal): the returned subset of
+    assumptions must itself be refuted by the clause set."""
+    from mythril_tpu.native import SatSolver
+
+    s = SatSolver()
+    a, b, c, d = (s.new_var() for _ in range(4))
+    s.add_clause([-a, -b])  # a & b contradict
+    # d is irrelevant noise
+    assert s.solve(assumptions=[a, b, c, d]) is False
+    core = s.core()
+    assert core, "non-empty core expected"
+    assert set(core) <= {a, b}, core
+    # the core alone must still be unsat
+    assert s.solve(assumptions=sorted(set(core))) is False
+    # and the query minus one core literal is satisfiable
+    assert s.solve(assumptions=[a, c, d]) is True
+
+
+def test_unsat_core_via_implication_chain():
+    from mythril_tpu.native import SatSolver
+
+    s = SatSolver()
+    a, b, x, y = (s.new_var() for _ in range(4))
+    s.add_clause([-a, x])   # a -> x
+    s.add_clause([-x, y])   # x -> y
+    s.add_clause([-y, -b])  # y -> !b
+    assert s.solve(assumptions=[a, b]) is False
+    core = set(s.core())
+    assert core <= {a, b} and core, core
+    assert s.solve(assumptions=sorted(core)) is False
+
+
+def test_session_core_subsumption():
+    """The incremental session answers a superset of a refuted core
+    without re-searching."""
+    from mythril_tpu.smt import And, Bool, symbol_factory
+    from mythril_tpu.smt.solver import core as score
+
+    score.reset_session()
+    hits0 = score.CORE_STATS["hits"]
+    x = symbol_factory.BitVecSym("core_x", 256)
+    contradiction = [
+        (x > symbol_factory.BitVecVal(100, 256)).raw,
+        (x < symbol_factory.BitVecVal(50, 256)).raw,
+    ]
+    r1 = score.check(contradiction)
+    assert r1.status == score.UNSAT
+    extra = symbol_factory.BitVecSym("core_y", 256)
+    r2 = score.check(contradiction
+                     + [(extra == symbol_factory.BitVecVal(7, 256)).raw])
+    assert r2.status == score.UNSAT
+    assert score.CORE_STATS["hits"] > hits0
+    score.reset_session()
