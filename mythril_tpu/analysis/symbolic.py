@@ -184,6 +184,33 @@ class SymExecWrapper:
                     break
         if lane_engine_active and not _device_exec_ok():
             lane_engine_active = False
+        if lane_engine_active:
+            # mirror of the sweep's link-aware engagement gate
+            # (lane_engine.device_break_even): on a tunneled backend a
+            # contract not known to fork wide will have its small
+            # waves declined anyway — dropping the dependency pruner
+            # for such a run would be the worst of both (no device, no
+            # pruning). Keep the pruner; its JUMPI hook idles the
+            # sweep, which is exactly the routing the gate would pick.
+            try:
+                from ..laser.lane_engine import (
+                    code_to_bytes,
+                    device_break_even,
+                )
+
+                code_bytes = code_to_bytes(contract.disassembly)
+                if (
+                    code_bytes is not None
+                    and device_break_even(code_bytes) > 1
+                ):
+                    # PATH_HISTORY for this code also fills from HOST
+                    # exploration (svm records the worklist peak), so
+                    # an in-process re-analysis of a wide-forking
+                    # contract flips this decision — no bootstrap
+                    # deadlock with the pruner
+                    lane_engine_active = False
+            except Exception:
+                pass  # unknown code shape: keep lane routing as-is
         if not disable_dependency_pruning and not lane_engine_active:
             plugin_loader.load(DependencyPrunerBuilder())
         elif lane_engine_active:

@@ -1148,7 +1148,16 @@ def _drain_warm_queue_at_exit() -> None:
             del q[:]
 
 
-atexit.register(_drain_warm_queue_at_exit)
+# threading._register_atexit callbacks run BEFORE Py_FinalizeEx joins
+# non-daemon threads — a plain atexit hook would fire only AFTER the
+# join, i.e. after the worker already compiled everything still queued.
+# Fall back to atexit on interpreters without the private API (the
+# drain is then merely late: shutdown waits for the queued compiles,
+# still no crash).
+try:
+    threading._register_atexit(_drain_warm_queue_at_exit)
+except Exception:  # pragma: no cover - CPython-version dependent
+    atexit.register(_drain_warm_queue_at_exit)
 
 
 # ops whose alu resolver takes pop-coerced bitvec args, keyed by arity
@@ -1181,6 +1190,26 @@ _ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
 #: wrong).
 DEFAULT_WINDOW = 256
 DEFAULT_STEP_BUDGET = 8192
+
+
+#: minimum tunneled wave size for device engagement: below this the
+#: fixed per-wave dispatch+pull round trip (~0.1-0.13 s on a tunneled
+#: link, payload-independent) exceeds the host interpreter's cost for
+#: the whole wave (~12 ms/path measured on corpus contracts)
+TUNNEL_BREAK_EVEN_WAVE = 24
+#: a code observed (or declared, e.g. by the bench pinning
+#: PATH_HISTORY) to fork at least this wide engages from any seed count
+WIDE_CODE_PATHS = 192
+
+
+def device_break_even(code: Optional[bytes] = None) -> int:
+    """Smallest wave worth dispatching to the device for `code` on the
+    current backend (svm._lane_engine_sweep's engagement gate)."""
+    if not _tunneled_backend():
+        return 1
+    if code is not None and PATH_HISTORY.get(code, 0) >= WIDE_CODE_PATHS:
+        return 1
+    return TUNNEL_BREAK_EVEN_WAVE
 
 
 #: per-code fork-scale observations: code -> peak width demand (lanes

@@ -1,13 +1,26 @@
 """Dependency pruner plugin (capability parity:
 mythril/laser/plugin/plugins/dependency_pruner.py:80-308).
 
-Builds per-basic-block read/write/call dependency maps across transactions;
-from transaction 2 on, a previously-seen block only executes when a storage
-slot it (or its path) reads may intersect a slot written in the previous
-transaction (solver-checked)."""
+Capability: from transaction 2 on, a basic block the engine has already
+explored only re-executes when a storage slot read on some path through
+it MAY alias a slot written by the previous transaction (or a CALL
+taints the path). Everything else about the re-visit is provably
+identical, so the state is skipped.
+
+Re-designed around a per-block dependency index and a memoized
+may-alias oracle rather than the reference's parallel path->list maps:
+
+- ``_BlockDeps`` holds, per jump-target address, the slots read and
+  written by any path through the block and whether a CALL taints it;
+- ``_may_alias`` answers "can these two slot terms be equal" with a
+  concrete fast path (no solver for two literals) and a symmetric
+  verdict memo — the same (read, write) term pair recurs across
+  hundreds of block re-visits in a sweep and the reference re-proved
+  it each time.
+"""
 
 import logging
-from typing import Dict, List, Set
+from typing import Dict, Iterable, List, Set
 
 from ....exceptions import UnsatError
 from ....support.model import get_model
@@ -23,17 +36,16 @@ log = logging.getLogger(__name__)
 
 def get_dependency_annotation(state: GlobalState) -> DependencyAnnotation:
     annotations = list(state.get_annotations(DependencyAnnotation))
-    if len(annotations) == 0:
-        # carry over the annotation stacked on the world state by the
-        # previous transaction's end states
-        try:
-            world_state_annotation = get_ws_dependency_annotation(state)
-            annotation = world_state_annotation.annotations_stack.pop()
-        except IndexError:
-            annotation = DependencyAnnotation()
-        state.annotate(annotation)
-    else:
-        annotation = annotations[0]
+    if annotations:
+        return annotations[0]
+    # fresh tx entry: adopt the annotation the previous transaction's
+    # end state stacked on the world state, if any
+    try:
+        annotation = get_ws_dependency_annotation(
+            state).annotations_stack.pop()
+    except IndexError:
+        annotation = DependencyAnnotation()
+    state.annotate(annotation)
     return annotation
 
 
@@ -42,19 +54,32 @@ def get_ws_dependency_annotation(state: GlobalState
     annotations = list(
         state.world_state.get_annotations(WSDependencyAnnotation)
     )
-    if len(annotations) == 0:
-        annotation = WSDependencyAnnotation()
-        state.world_state.annotate(annotation)
-    else:
-        annotation = annotations[0]
+    if annotations:
+        return annotations[0]
+    annotation = WSDependencyAnnotation()
+    state.world_state.annotate(annotation)
     return annotation
 
 
-class DependencyPrunerBuilder(PluginBuilder):
-    name = "dependency-pruner"
+class _BlockDeps:
+    """Dependency summary of one jump-target block: which storage
+    slots any path through it reads, whether any such path writes
+    storage, and whether a CALL makes its effects unskippable."""
 
-    def __call__(self, *args, **kwargs):
-        return DependencyPruner()
+    __slots__ = ("reads", "writes", "call_tainted")
+
+    def __init__(self):
+        # dict-as-ordered-set keyed by term identity: slot TERMS are
+        # hash-consed, so identity dedup is exact and insertion order
+        # keeps the alias probes deterministic
+        self.reads: Dict[object, None] = {}
+        self.writes: bool = False
+        self.call_tainted: bool = False
+
+
+def _tid(term) -> object:
+    raw = getattr(term, "raw", None)
+    return raw.tid if raw is not None else term
 
 
 class DependencyPruner(LaserPlugin):
@@ -65,61 +90,87 @@ class DependencyPruner(LaserPlugin):
 
     def _reset(self):
         self.iteration = 0
-        self.calls_on_path: Dict[int, bool] = {}
-        self.sloads_on_path: Dict[int, List[object]] = {}
-        self.sstores_on_path: Dict[int, List[object]] = {}
-        self.storage_accessed_global: Set = set()
+        self._deps: Dict[int, _BlockDeps] = {}
+        # every slot term read anywhere this run (the reference's
+        # storage_accessed_global — membership tests against it keep
+        # the set's hash-then-eq semantics, see _must_rerun)
+        self._slots_read_anywhere: Set = set()
+        # symmetric may-alias verdict memo over term identities
+        self._alias_memo: Dict[frozenset, bool] = {}
 
-    def update_sloads(self, path: List[int], target_location) -> None:
+    # -- dependency index --------------------------------------------------
+
+    def _block(self, address: int) -> _BlockDeps:
+        deps = self._deps.get(address)
+        if deps is None:
+            deps = self._deps[address] = _BlockDeps()
+        return deps
+
+    def _record_read(self, path: List[int], slot) -> None:
         for address in path:
-            entry = self.sloads_on_path.setdefault(address, [])
-            if target_location not in entry:
-                entry.append(target_location)
+            self._block(address).reads.setdefault(slot)
 
-    def update_sstores(self, path: List[int], target_location) -> None:
+    def _record_write(self, path: List[int]) -> None:
         for address in path:
-            entry = self.sstores_on_path.setdefault(address, [])
-            if target_location not in entry:
-                entry.append(target_location)
+            self._block(address).writes = True
 
-    def update_calls(self, path: List[int]) -> None:
+    def _record_call(self, path: List[int]) -> None:
+        # a CALL only pins blocks that also write storage: the
+        # reference's calls_on_path is keyed on sstores_on_path entries
         for address in path:
-            if address in self.sstores_on_path:
-                self.calls_on_path[address] = True
+            deps = self._deps.get(address)
+            if deps is not None and deps.writes:
+                deps.call_tainted = True
 
-    def wanna_execute(self, address: int,
-                      annotation: DependencyAnnotation) -> bool:
-        """Should the (previously seen) block at `address` run again?"""
-        storage_write_cache = annotation.get_storage_write_cache(
-            self.iteration - 1
+    # -- the may-alias oracle ----------------------------------------------
+
+    def _may_alias(self, a, b) -> bool:
+        va = getattr(a, "value", None)
+        vb = getattr(b, "value", None)
+        if va is not None and vb is not None:
+            return va == vb  # two literals: no solver
+        key = frozenset((_tid(a), _tid(b)))
+        verdict = self._alias_memo.get(key)
+        if verdict is None:
+            try:
+                get_model((a == b,))
+                verdict = True
+            except UnsatError:
+                verdict = False
+            except Exception:
+                verdict = True  # unknown must not prune
+            self._alias_memo[key] = verdict
+        return verdict
+
+    def _any_alias(self, slots: Iterable, others: Iterable) -> bool:
+        others = list(others)
+        return any(
+            self._may_alias(s, o) for s in slots for o in others
         )
-        if address in self.calls_on_path:
+
+    # -- the skip decision -------------------------------------------------
+
+    def _must_rerun(self, address: int,
+                    annotation: DependencyAnnotation) -> bool:
+        """Does re-executing the (previously seen) block at `address`
+        possibly observe the previous transaction's writes?"""
+        deps = self._deps.get(address)
+        if deps is not None and deps.call_tainted:
             return True
-        # pure paths with no read dependencies can be skipped outright
-        if address not in self.sloads_on_path:
-            return False
-        if address in self.storage_accessed_global:
-            for location in self.sstores_on_path:
-                try:
-                    get_model((location == address,))
-                    return True
-                except UnsatError:
-                    continue
-        dependencies = self.sloads_on_path[address]
-        for location in storage_write_cache:
-            for dependency in dependencies:
-                try:
-                    get_model((location == dependency,))
-                    return True
-                except UnsatError:
-                    continue
-            for dependency in annotation.storage_loaded:
-                try:
-                    get_model((location == dependency,))
-                    return True
-                except UnsatError:
-                    continue
-        return False
+        if deps is None or not deps.reads:
+            return False  # no read on any path through it: pure
+        prev_writes = annotation.get_storage_write_cache(
+            self.iteration - 1)
+        # reference conservatism (storage_accessed_global): a block
+        # whose own address shows up as a read slot AND whose paths
+        # write storage reruns unconditionally. The membership test
+        # deliberately keeps the original set semantics (hash first,
+        # term __eq__ on collision).
+        if deps.writes and address in self._slots_read_anywhere:
+            return True
+        if self._any_alias(prev_writes, deps.reads):
+            return True
+        return self._any_alias(prev_writes, annotation.storage_loaded)
 
     def initialize(self, symbolic_vm) -> None:
         self._reset()
@@ -128,90 +179,68 @@ class DependencyPruner(LaserPlugin):
         def start_sym_trans_hook():
             self.iteration += 1
 
-        def _check_basic_block(address: int,
-                               annotation: DependencyAnnotation):
+        def _visit_jump_target(state: GlobalState):
+            try:
+                address = state.get_current_instruction()["address"]
+            except IndexError:
+                raise PluginSkipState
+            annotation = get_dependency_annotation(state)
+            annotation.path.append(address)
             if self.iteration < 2:
                 return
             if address not in annotation.blocks_seen:
                 annotation.blocks_seen.add(address)
                 return
-            if self.wanna_execute(address, annotation):
+            if self._must_rerun(address, annotation):
                 return
             log.debug(
-                "Skipping state: storage slots %s not read in block at "
-                "address %d",
+                "Skipping state: previous-tx writes %s cannot reach a "
+                "read in block at address %d",
                 annotation.get_storage_write_cache(self.iteration - 1),
                 address,
             )
             raise PluginSkipState
 
-        @symbolic_vm.post_hook("JUMP")
-        def jump_hook(state: GlobalState):
-            try:
-                address = state.get_current_instruction()["address"]
-            except IndexError:
-                raise PluginSkipState
-            annotation = get_dependency_annotation(state)
-            annotation.path.append(address)
-            _check_basic_block(address, annotation)
-
-        @symbolic_vm.post_hook("JUMPI")
-        def jumpi_hook(state: GlobalState):
-            try:
-                address = state.get_current_instruction()["address"]
-            except IndexError:
-                raise PluginSkipState
-            annotation = get_dependency_annotation(state)
-            annotation.path.append(address)
-            _check_basic_block(address, annotation)
+        for opcode in ("JUMP", "JUMPI"):
+            symbolic_vm.post_hook(opcode)(_visit_jump_target)
 
         @symbolic_vm.pre_hook("SSTORE")
         def sstore_hook(state: GlobalState):
             annotation = get_dependency_annotation(state)
-            location = state.mstate.stack[-1]
-            self.update_sstores(annotation.path, location)
+            self._record_write(annotation.path)
             annotation.extend_storage_write_cache(
-                self.iteration, location
+                self.iteration, state.mstate.stack[-1]
             )
 
         @symbolic_vm.pre_hook("SLOAD")
         def sload_hook(state: GlobalState):
             annotation = get_dependency_annotation(state)
-            location = state.mstate.stack[-1]
-            if location not in annotation.storage_loaded:
-                annotation.storage_loaded.add(location)
-            # backwards-annotate: execution may never reach STOP/RETURN
-            self.update_sloads(annotation.path, location)
-            self.storage_accessed_global.add(location)
+            slot = state.mstate.stack[-1]
+            annotation.storage_loaded.add(slot)
+            # backwards-annotate immediately: execution may never reach
+            # a clean STOP/RETURN on this path
+            self._record_read(annotation.path, slot)
+            self._slots_read_anywhere.add(slot)
 
-        @symbolic_vm.pre_hook("CALL")
-        def call_hook(state: GlobalState):
+        def _call_hook(state: GlobalState):
             annotation = get_dependency_annotation(state)
-            self.update_calls(annotation.path)
+            self._record_call(annotation.path)
             annotation.has_call = True
 
-        @symbolic_vm.pre_hook("STATICCALL")
-        def staticcall_hook(state: GlobalState):
-            annotation = get_dependency_annotation(state)
-            self.update_calls(annotation.path)
-            annotation.has_call = True
+        for opcode in ("CALL", "STATICCALL"):
+            symbolic_vm.pre_hook(opcode)(_call_hook)
 
         def _transaction_end(state: GlobalState) -> None:
             annotation = get_dependency_annotation(state)
-            for index in annotation.storage_loaded:
-                self.update_sloads(annotation.path, index)
-            for index in annotation.storage_written:
-                self.update_sstores(annotation.path, index)
+            for slot in annotation.storage_loaded:
+                self._record_read(annotation.path, slot)
+            if annotation.storage_written:
+                self._record_write(annotation.path)
             if annotation.has_call:
-                self.update_calls(annotation.path)
+                self._record_call(annotation.path)
 
-        @symbolic_vm.pre_hook("STOP")
-        def stop_hook(state: GlobalState):
-            _transaction_end(state)
-
-        @symbolic_vm.pre_hook("RETURN")
-        def return_hook(state: GlobalState):
-            _transaction_end(state)
+        for opcode in ("STOP", "RETURN"):
+            symbolic_vm.pre_hook(opcode)(_transaction_end)
 
         @symbolic_vm.laser_hook("add_world_state")
         def world_state_filter_hook(state: GlobalState):
@@ -226,3 +255,10 @@ class DependencyPruner(LaserPlugin):
             annotation.path = [0]
             annotation.storage_loaded = set()
             world_state_annotation.annotations_stack.append(annotation)
+
+
+class DependencyPrunerBuilder(PluginBuilder):
+    name = "dependency-pruner"
+
+    def __call__(self, *args, **kwargs):
+        return DependencyPruner()

@@ -401,6 +401,36 @@ class LaserEVM:
         verdict = {id(gs): _device_ok(gs) for gs in self.work_list}
         if sum(verdict.values()) < min_batch:
             return  # device round trips don't pay for a trickle
+        # link-aware break-even, per contract: on a tunneled backend
+        # each wave pays a fixed ~0.1-0.13 s dispatch+pull round trip
+        # (measured payload-independent), so a wave smaller than the
+        # break-even batch runs FASTER on the host interpreter — the
+        # lane cap is capacity, not a mandate (pick_width's rule,
+        # applied to engagement). A code whose observed fork scale
+        # (PATH_HISTORY) is wide engages immediately even from one
+        # seed: the wave will fan out on device. Worklists that
+        # outgrow the threshold engage at the periodic re-sweep.
+        from .lane_engine import device_break_even
+
+        wave_count: Dict[bytes, int] = {}
+        for gs in self.work_list:
+            if verdict[id(gs)]:
+                code = code_of[id(gs)]
+                wave_count[code] = wave_count.get(code, 0) + 1
+        declined = 0
+        for gs_id, ok in verdict.items():
+            if not ok:
+                continue
+            code = code_of[gs_id]
+            if wave_count[code] < device_break_even(code):
+                verdict[gs_id] = False
+                declined += 1
+        if declined:
+            log.info(
+                "lane engine: %d states below the link break-even "
+                "batch stay host-side", declined)
+        if not any(verdict.values()):
+            return
         eligible = self.strategy.drain_eligible(
             lambda gs: verdict[id(gs)])
         groups: Dict[bytes, List[GlobalState]] = {}
@@ -535,6 +565,17 @@ class LaserEVM:
                 elif track_gas:
                     final_states.append(global_state)
                 self.total_states += len(new_states)
+                # fork-scale history also fills from HOST exploration:
+                # the engagement gate (lane_engine.device_break_even)
+                # flips for a demonstrably wide-forking code on the
+                # next in-process analysis, even though the pruner
+                # idled the sweep for this one
+                if args.tpu_lanes and len(new_states) > 1:
+                    peak = len(self.work_list)
+                    if peak > getattr(self, "_worklist_peak", 0):
+                        self._worklist_peak = peak
+                        self._record_fork_scale(
+                            global_state.environment.code, peak)
         finally:
             # cross-state PotentialIssue wave: every end state's
             # candidates screen in ONE interval batch (device-sized
@@ -546,6 +587,19 @@ class LaserEVM:
         for hook in self._stop_exec_hooks:
             hook()
         return final_states if track_gas else None
+
+    @staticmethod
+    def _record_fork_scale(code_obj, peak: int) -> None:
+        """Feed the host worklist peak into the lane engine's per-code
+        fork-scale history (best-effort)."""
+        try:
+            from .lane_engine import PATH_HISTORY, code_to_bytes
+
+            code = code_to_bytes(code_obj)
+            if code and peak > PATH_HISTORY.get(code, 0):
+                PATH_HISTORY[code] = peak
+        except Exception:
+            pass
 
     def _discharge_pi_wave(self) -> None:
         states = getattr(self, "_pi_wave", None)
