@@ -53,6 +53,16 @@ class MutationPruner(LaserPlugin):
                 ContractCreationTransaction,
             ):
                 return
+            # a state is skipped only when it has NO mutation annotation
+            # AND a positive callvalue is infeasible — so test the
+            # annotation first: storage-mutated end states (the common
+            # case, and EVERY lane-retired terminal in a fork storm)
+            # keep their world state without any solver query. The
+            # reference solves first (mutation_pruner.py:49-66); the
+            # outcome is identical, but one get_model per end state was
+            # the single largest host cost of a 32k-path terminal storm
+            if list(global_state.get_annotations(MutationAnnotation)):
+                return
             if isinstance(global_state.environment.callvalue, int):
                 callvalue = symbol_factory.BitVecVal(
                     global_state.environment.callvalue, 256
@@ -67,12 +77,4 @@ class MutationPruner(LaserPlugin):
                 return  # balance mutation possible
             except UnsatError:
                 pass
-            if (
-                len(
-                    list(
-                        global_state.get_annotations(MutationAnnotation)
-                    )
-                )
-                == 0
-            ):
-                raise PluginSkipWorldState
+            raise PluginSkipWorldState

@@ -571,11 +571,25 @@ class LaserEVM:
                 # next in-process analysis, even though the pruner
                 # idled the sweep for this one
                 if args.tpu_lanes and len(new_states) > 1:
-                    peak = len(self.work_list)
-                    if peak > getattr(self, "_worklist_peak", 0):
-                        self._worklist_peak = peak
-                        self._record_fork_scale(
-                            global_state.environment.code, peak)
+                    code_obj = global_state.environment.code
+                    peaks = getattr(self, "_fork_peaks", None)
+                    if peaks is None:
+                        peaks = self._fork_peaks = {}
+                    key = id(code_obj)
+                    seen = peaks.get(key, 0)
+                    # len(work_list) only BOUNDS this code's share (a
+                    # mixed-code worklist must not inflate a narrow
+                    # code's scale); re-count the actual share on a
+                    # geometric schedule so a fork storm pays O(log)
+                    # full walks, not one per fork
+                    if len(self.work_list) > max(2 * seen, seen + 32):
+                        peak = sum(
+                            1 for s in self.work_list
+                            if s.environment.code is code_obj
+                        )
+                        if peak > seen:
+                            peaks[key] = peak
+                            self._record_fork_scale(code_obj, peak)
         finally:
             # cross-state PotentialIssue wave: every end state's
             # candidates screen in ONE interval batch (device-sized
