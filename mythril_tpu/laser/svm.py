@@ -574,21 +574,25 @@ class LaserEVM:
                     code_obj = global_state.environment.code
                     peaks = getattr(self, "_fork_peaks", None)
                     if peaks is None:
+                        # keyed by the code OBJECT (holds a reference:
+                        # an id() key could be reused after GC and
+                        # hand a new code a stale peak)
                         peaks = self._fork_peaks = {}
-                    key = id(code_obj)
-                    seen = peaks.get(key, 0)
+                    seen, last_len = peaks.get(code_obj, (0, 0))
                     # len(work_list) only BOUNDS this code's share (a
                     # mixed-code worklist must not inflate a narrow
-                    # code's scale); re-count the actual share on a
-                    # geometric schedule so a fork storm pays O(log)
-                    # full walks, not one per fork
-                    if len(self.work_list) > max(2 * seen, seen + 32):
+                    # code's scale); re-count the actual share only
+                    # when the TOTAL length doubled since the last
+                    # count, so a fork storm pays O(log) full walks
+                    # even when another code floods the list
+                    length = len(self.work_list)
+                    if length > max(2 * last_len, last_len + 32):
                         peak = sum(
                             1 for s in self.work_list
                             if s.environment.code is code_obj
                         )
+                        peaks[code_obj] = (max(peak, seen), length)
                         if peak > seen:
-                            peaks[key] = peak
                             self._record_fork_scale(code_obj, peak)
         finally:
             # cross-state PotentialIssue wave: every end state's

@@ -819,18 +819,4 @@ def _extract_model_inner(md, blaster, sat, subs, select_map, apply_map,
     return md
 
 
-_TID_INDEX: Dict[int, "T.Term"] = {}
-_TID_INDEXED_UPTO = [0]
-
-
-def _term_by_tid(tid: int) -> Optional["T.Term"]:
-    # _table is insertion-ordered and append-only: index only the suffix
-    # of terms created since the last call (amortized O(new terms))
-    if len(_TID_INDEX) != T.dag_size():
-        import itertools
-
-        skip = _TID_INDEXED_UPTO[0]
-        for t in itertools.islice(T._table.values(), skip, None):
-            _TID_INDEX[t.tid] = t
-        _TID_INDEXED_UPTO[0] = T.dag_size()
-    return _TID_INDEX.get(tid)
+_term_by_tid = T.term_by_tid

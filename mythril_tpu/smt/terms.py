@@ -949,3 +949,21 @@ def collect(t: Term, pred, out=None, seen=None):
             out.append(cur)
         stack.extend(cur.args)
     return out
+
+
+_TID_INDEX: Dict[int, Term] = {}
+_TID_INDEXED_UPTO = [0]
+
+
+def term_by_tid(tid: int):
+    """Term for a tid, or None. `_table` is insertion-ordered and
+    append-only: only the suffix of terms created since the last call
+    is indexed (amortized O(new terms))."""
+    if len(_TID_INDEX) != len(_table):
+        import itertools
+
+        for t in itertools.islice(_table.values(), _TID_INDEXED_UPTO[0],
+                                  None):
+            _TID_INDEX[t.tid] = t
+        _TID_INDEXED_UPTO[0] = len(_table)
+    return _TID_INDEX.get(tid)

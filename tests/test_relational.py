@@ -1,0 +1,115 @@
+"""Relational balance-delta refutation (smt/relational.py): the
+attacker-profit shape ether_thief emits (reference
+mythril/analysis/module/modules/ether_thief.py:44-79) must refute
+structurally when only guarded outflows touch the balance, must NOT
+refute when an unguarded inflow exists, and must stay sound (a later
+CDCL answer agrees)."""
+
+from mythril_tpu.smt import (
+    UGE,
+    UGT,
+    Array,
+    symbol_factory,
+)
+from mythril_tpu.smt.relational import STATS, relational_unsat
+
+ATT = 0xDEADBEEF
+
+
+def _balances():
+    return Array("t_balance_%d" % STATS["attempts"], 256, 256)
+
+
+def _attacker():
+    return symbol_factory.BitVecVal(ATT, 256)
+
+
+def test_outflow_only_refutes():
+    """start - v with the no-underflow guard v <= start: unsat."""
+    balances = _balances()
+    v = symbol_factory.BitVecSym("t_out_v", 256)
+    start = balances[_attacker()]
+    guard = UGE(start, v)
+    balances[_attacker()] -= v
+    profit = UGT(balances[_attacker()], start)
+    assert relational_unsat((guard, profit)) is True
+
+
+def test_outflow_chain_refutes():
+    """Two sequential outflows, each guarded at its own prefix."""
+    balances = _balances()
+    v1 = symbol_factory.BitVecSym("t_ch_v1", 256)
+    v2 = symbol_factory.BitVecSym("t_ch_v2", 256)
+    start = balances[_attacker()]
+    g1 = UGE(balances[_attacker()], v1)
+    balances[_attacker()] -= v1
+    g2 = UGE(balances[_attacker()], v2)
+    balances[_attacker()] -= v2
+    profit = UGT(balances[_attacker()], start)
+    assert relational_unsat((g1, g2, profit)) is True
+
+
+def test_unguarded_outflow_not_refuted():
+    """Without the no-underflow guard the subtraction may wrap: the
+    refuter must NOT claim unsat (profit by underflow is a model)."""
+    balances = _balances()
+    v = symbol_factory.BitVecSym("t_ug_v", 256)
+    start = balances[_attacker()]
+    balances[_attacker()] -= v
+    profit = UGT(balances[_attacker()], start)
+    assert relational_unsat((profit,)) is False
+
+
+def test_inflow_not_refuted():
+    """An unguarded inflow means profit is satisfiable."""
+    balances = _balances()
+    amount = symbol_factory.BitVecSym("t_in_a", 256)
+    start = balances[_attacker()]
+    balances[_attacker()] += amount
+    profit = UGT(balances[_attacker()], start)
+    assert relational_unsat((profit,)) is False
+
+
+def test_pingpong_refutes():
+    """Deposit v then receive exactly v back: no strict profit."""
+    balances = _balances()
+    v = symbol_factory.BitVecSym("t_pp_v", 256)
+    start = balances[_attacker()]
+    g = UGE(balances[_attacker()], v)
+    balances[_attacker()] -= v
+    balances[_attacker()] += v
+    profit = UGT(balances[_attacker()], start)
+    assert relational_unsat((g, profit)) is True
+
+
+def test_bounded_inflow_refutes():
+    """Inflow a <= v (the contract returns at most the deposit),
+    deposit v guarded: profit = a - v <= 0."""
+    balances = _balances()
+    v = symbol_factory.BitVecSym("t_bi_v", 256)
+    a = symbol_factory.BitVecSym("t_bi_a", 256)
+    start = balances[_attacker()]
+    g1 = UGE(balances[_attacker()], v)
+    balances[_attacker()] -= v
+    g2 = UGE(v, a)
+    balances[_attacker()] += a
+    profit = UGT(balances[_attacker()], start)
+    assert relational_unsat((g1, g2, profit)) is True
+
+
+def test_agrees_with_cdcl():
+    """Soundness spot-check: whenever the refuter answers unsat, the
+    CDCL core must agree on the same constraint set."""
+    from mythril_tpu.smt import And
+    from mythril_tpu.smt.solver import Solver, unsat
+
+    balances = _balances()
+    v = symbol_factory.BitVecSym("t_sc_v", 256)
+    start = balances[_attacker()]
+    guard = UGE(start, v)
+    balances[_attacker()] -= v
+    profit = UGT(balances[_attacker()], start)
+    if relational_unsat((guard, profit)):
+        s = Solver()
+        s.add(And(guard, profit))
+        assert s.check() == unsat
