@@ -224,8 +224,24 @@ LAST_RUN_STATS: Optional[dict] = None
 RUN_STATS_TOTAL: Dict[str, int] = {}
 
 
+@functools.lru_cache(maxsize=65536)
+def _bv_raw(v: int):
+    return symbol_factory.BitVecVal(v, 256).raw
+
+
+@functools.lru_cache(maxsize=256)
+def _bv8_raw(v: int):
+    return symbol_factory.BitVecVal(v, 8).raw
+
+
 def _bv_val(v: int) -> BitVec:
-    return symbol_factory.BitVecVal(v, 256)
+    """256-bit constant facade over a memoized term: materialization
+    interns the same slot keys / small constants tens of times per
+    path across a terminal storm, and the intern round trip dominated
+    the stack/storage rebuild. The facade itself stays per-call —
+    Expression.annotate mutates in place, so instances must not be
+    shared across paths."""
+    return BitVec(_bv_raw(v))
 
 
 def _geo_bucket(k: int, cap: int, floor: int) -> int:
@@ -613,19 +629,18 @@ def _fork_table(st: SymLaneState, fb: int):
     ], axis=1)
 
 
-@jax.jit
-def _unique_table_big(st: SymLaneState):
+@functools.partial(jax.jit, static_argnums=(1,))
+def _unique_table_big(st: SymLaneState, urb: int):
     """Escalation: recompute the canonical set (idempotent — the sid
-    planes are already canonical) and pull it at the big budget, for
-    the rare window whose distinct-record count exceeds URB. The
-    budget scales with the lane count (cross-seed-group records never
-    dedup, so a big seed bucket can mint ~4 distinct records per lane
-    in one window); beyond it the explore raises and the sweep reroutes
-    the batch to the host interpreter (svm._lane_engine_sweep's
-    fallback) — degraded, never wrong."""
+    planes are already canonical) and pull it at `urb` rows, for the
+    window whose distinct-record count exceeds the fused pull's URB.
+    The caller sizes urb geometrically from the ucount it already has
+    (the old fixed worst-case budget shipped a 35 MB table over the
+    tunnel to deliver a few thousand rows — ~8 s per escalating
+    window); beyond the worst case the explore raises and the sweep
+    reroutes the batch to the host interpreter — degraded, never
+    wrong."""
     d_recs = st.dlog_op.shape[1]
-    n = st.pc.shape[0]
-    urb = min(n * d_recs, max(4096, 8 * n))
     _, canon_pid = _dedup_canon(st, d_recs)
     return _unique_table(st, canon_pid, d_recs, urb)
 
@@ -2094,8 +2109,7 @@ class LaneEngine:
                 if k == symstep.KIND_BYTE_INT:
                     ms.memory[i] = int(mem[i])
                 elif k == symstep.KIND_CONC_WORD:
-                    ms.memory[i] = symbol_factory.BitVecVal(
-                        int(mem[i]), 8)
+                    ms.memory[i] = BitVec(_bv8_raw(int(mem[i])))
                 else:  # KIND_SYM_WORD
                     obj, j = sym_cover[i]
                     if isinstance(obj, Bool):
@@ -2318,9 +2332,19 @@ class LaneEngine:
                 nf = counts_h["flog_count"]
                 ucount = counts_h["ucount"]
                 if ucount > utab.shape[0]:
-                    # rare: more distinct records than the table budget
+                    # more distinct records than the fused pull budget:
+                    # re-pull at the smallest geometric bucket that
+                    # fits the count we already have (a few compiles,
+                    # cached per bucket; the table ships right-sized)
+                    cap = self.n_lanes * self.lane_kwargs.get(
+                        "dlog_records", 64)
+                    urb_big = utab.shape[0]
+                    while urb_big < ucount and urb_big < cap:
+                        urb_big *= 2
+                    urb_big = min(urb_big, cap)
                     with _prof("logs_escalate"):
-                        utab, uc2 = jax.device_get(_unique_table_big(st))
+                        utab, uc2 = jax.device_get(
+                            _unique_table_big(st, urb_big))
                     utab = np.asarray(utab)
                     ucount = int(uc2)
                     if ucount > utab.shape[0]:

@@ -73,18 +73,17 @@ class Storage:
             self.storage_keys_loaded.add(int(key.value))
 
     def __deepcopy__(self, memodict=dict()):
-        concrete = isinstance(
-            self._standard_storage, K
-        )
-        storage = Storage(
-            concrete=concrete, address=self.address,
-            dynamic_loader=self.dynld
-        )
-        # share the underlying immutable term; per-object raw rebinding on
-        # write keeps copies independent
+        # field-by-field via __new__: the constructor would build a
+        # throwaway array facade per copy, and storage copies run once
+        # per fork (hot in terminal storms). Shares the underlying
+        # immutable term; per-object raw rebinding on write keeps
+        # copies independent.
+        storage = Storage.__new__(Storage)
         storage._standard_storage = copy(self._standard_storage)
         storage._printable_storage = copy(self._printable_storage)
+        storage.dynld = self.dynld
         storage.storage_keys_loaded = copy(self.storage_keys_loaded)
+        storage.address = self.address
         storage.keys_get = copy(self.keys_get)
         storage.keys_set = copy(self.keys_set)
         return storage
@@ -174,13 +173,15 @@ class Account:
         }
 
     def __copy__(self, memodict={}):
-        new_account = Account(
-            address=self.address,
-            code=self.code,
-            contract_name=self.contract_name,
-            balances=self._balances,
-            nonce=self.nonce,
-        )
-        new_account.storage = deepcopy(self.storage)
+        # field-by-field via __new__ (the constructor builds a
+        # throwaway Storage); `deleted` intentionally resets to False,
+        # matching the constructor-based copy this replaces
+        new_account = Account.__new__(Account)
+        new_account.nonce = self.nonce
         new_account.code = self.code
+        new_account.address = self.address
+        new_account.storage = deepcopy(self.storage)
+        new_account._balances = self._balances
+        new_account.contract_name = self.contract_name
+        new_account.deleted = False
         return new_account

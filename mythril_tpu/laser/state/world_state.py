@@ -79,17 +79,22 @@ class WorldState:
             return new_account
 
     def __copy__(self) -> "WorldState":
-        new_annotations = [copy(a) for a in self._annotations]
-        new_world_state = WorldState(
-            transaction_sequence=self.transaction_sequence[:],
-            annotations=new_annotations,
-        )
+        # field-by-field via __new__: the constructor would intern a
+        # throwaway balance array per copy, and world-state copies run
+        # once per fork and once per terminal materialization
+        new_world_state = WorldState.__new__(WorldState)
+        new_world_state._accounts = {}
         new_world_state.balances = copy(self.balances)
         new_world_state.starting_balances = copy(self.starting_balances)
+        new_world_state.constraints = copy(self.constraints)
+        new_world_state.node = self.node
+        new_world_state.transaction_sequence = \
+            self.transaction_sequence[:]
+        new_world_state._annotations = [
+            copy(a) for a in self._annotations
+        ]
         for account in self._accounts.values():
             new_world_state.put_account(copy(account))
-        new_world_state.node = self.node
-        new_world_state.constraints = copy(self.constraints)
         return new_world_state
 
     def __deepcopy__(self, _) -> "WorldState":

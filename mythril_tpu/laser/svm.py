@@ -574,10 +574,15 @@ class LaserEVM:
                     code_obj = global_state.environment.code
                     peaks = getattr(self, "_fork_peaks", None)
                     if peaks is None:
-                        # keyed by the code OBJECT (holds a reference:
-                        # an id() key could be reused after GC and
-                        # hand a new code a stale peak)
-                        peaks = self._fork_peaks = {}
+                        # keyed by the code OBJECT, weakly: an id() key
+                        # could be reused after GC and hand a new code
+                        # a stale peak, while a strong key would pin
+                        # every retired Disassembly for the engine's
+                        # lifetime
+                        import weakref
+
+                        peaks = self._fork_peaks = \
+                            weakref.WeakKeyDictionary()
                     seen, last_len = peaks.get(code_obj, (0, 0))
                     # len(work_list) only BOUNDS this code's share (a
                     # mixed-code worklist must not inflate a narrow

@@ -56,10 +56,14 @@ def is_false(a: Bool) -> bool:
     return a.is_false
 
 
-def _union_annotations(*items) -> Set:
-    out = set()
+def _union_annotations(*items) -> Optional[Set]:
+    """None when no operand carries annotations — the common case; the
+    Expression constructor treats None as empty without allocating."""
+    out = None
     for it in items:
-        out |= it.annotations
+        ann = it._annotations
+        if ann:
+            out = set(ann) if out is None else (out | ann)
     return out
 
 

@@ -18,8 +18,13 @@ class Expression(Generic[G]):
 
     def __init__(self, raw: "T.Term", annotations: Optional[Set] = None):
         self.raw = raw
-        if annotations is None:
-            self._annotations = set()
+        # lazy: most facades never carry annotations, and the empty-set
+        # allocation per wrapper dominated terminal-storm
+        # materialization (None stands for "empty"; materialized on
+        # first annotate). Callers treat `annotations` as read-only
+        # (union/iterate) — smt/bool._union_annotations et al.
+        if not annotations:
+            self._annotations = None  # empty set normalizes too
         elif isinstance(annotations, set):
             self._annotations = annotations
         else:
@@ -27,17 +32,24 @@ class Expression(Generic[G]):
 
     @property
     def annotations(self) -> Set:
-        return self._annotations
+        ann = self._annotations
+        return ann if ann is not None else set()
 
     @annotations.setter
     def annotations(self, value) -> None:
         self._annotations = set(value)
 
     def annotate(self, annotation) -> None:
-        self._annotations.add(annotation)
+        if self._annotations is None:
+            self._annotations = {annotation}
+        else:
+            self._annotations.add(annotation)
 
     def get_annotations(self, annotation_type: type) -> List:
-        return [a for a in self._annotations if isinstance(a, annotation_type)]
+        ann = self._annotations
+        if not ann:
+            return []
+        return [a for a in ann if isinstance(a, annotation_type)]
 
     def __repr__(self) -> str:
         return repr(self.raw)
