@@ -1295,11 +1295,15 @@ class LaneEngine:
     def __init__(self, n_lanes: int = 256, window: int = DEFAULT_WINDOW,
                  step_budget: int = DEFAULT_STEP_BUDGET,
                  blocked_ops=None, adapters=None, mesh=None,
-                 **lane_kwargs):
+                 slim_stop: bool = False, **lane_kwargs):
         self.n_lanes = n_lanes
         self.window = window
         self.step_budget = step_budget
         self.lane_kwargs = lane_kwargs
+        #: svm guarantees no essential hook watches STOP: lanes parked
+        #: at a top-level STOP materialize without the stack/memory
+        #: rebuild the STOP transaction-end path never reads
+        self.slim_stop = slim_stop
         # multi-device SPMD: when a jax.sharding.Mesh is supplied, the
         # lane planes live sharded over its `lanes` axis and every
         # fused dispatch runs SPMD under GSPMD partitioning — the SAME
@@ -2069,12 +2073,26 @@ class LaneEngine:
         ms.min_gas_used = ctx.gas0_min + int(st_host["min_gas"][lane])
         ms.max_gas_used = ctx.gas0_max + int(st_host["max_gas"][lane])
 
+        # top-level STOP park with slim_stop: the transaction-end path
+        # (svm._fast_terminal, or the normal STOP path when it
+        # declines) reads neither the stack nor memory bytes — skip
+        # both rebuilds. Storage, constraints, gas, promotions, and
+        # annotations below still rebuild in full.
+        slim = (
+            self.slim_stop
+            and ms.pc < len(gs.environment.code.instruction_list)
+            and gs.environment.code.instruction_list[ms.pc]["opcode"]
+            == "STOP"
+            and gs.transaction_stack
+            and gs.transaction_stack[-1][1] is None
+        )
+
         # stack: the device planes hold the COMPLETE current stack
         # (mid-path re-seeds arrive with the template's entries already
         # on device) — rebuild from scratch, never append to the
         # template's copy
         del ms.stack[:]
-        sp = int(st_host["sp"][lane])
+        sp = 0 if slim else int(st_host["sp"][lane])
         for s in range(sp):
             sid = int(st_host["ssid"][lane, s])
             if sid:
@@ -2092,6 +2110,9 @@ class LaneEngine:
         ms.memory._memory.clear()
         ms.memory._msize = 0
         msize = int(st_host["msize"][lane])
+        if slim:
+            ms.memory._msize = msize  # size for fidelity, no content
+            msize = 0
         if msize:
             ms.memory.extend(msize)
             mem = st_host["memory"][lane]

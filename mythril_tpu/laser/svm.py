@@ -388,10 +388,25 @@ class LaserEVM:
         code_of: Dict[int, bytes] = {}
 
         def _device_ok(gs: GlobalState) -> bool:
+            # memoized on the state: a queued state does not mutate
+            # between sweeps, and the periodic re-sweep otherwise
+            # re-pays lane_seedable's stack/memory scans for the whole
+            # worklist (terminal storms re-scan every parked state).
+            # The memo does not survive GlobalState.__copy__ (fresh
+            # __dict__), so post-step descendants re-evaluate.
+            cached = gs.__dict__.get("_lane_verdict")
+            if cached is not None:
+                code = cached
+                if code is False:
+                    return False
+                code_of[id(gs)] = code
+                return True
             code = code_to_bytes(gs.environment.code)
             if code and lane_seedable(gs, exec_table=table):
                 code_of[id(gs)] = code
+                gs._lane_verdict = code
                 return True
+            gs._lane_verdict = False
             return False
 
         # count first, drain only on commitment: a drain-and-put-back
@@ -447,6 +462,22 @@ class LaserEVM:
             warm_variant,
         )
 
+        # no ESSENTIAL hook on STOP — on EITHER channel: the
+        # instruction channel (instr_pre/post_hook, fired inside
+        # Instruction.evaluate) AND the detector channel (pre/post_
+        # hooks, fired via _execute_pre_hook; unchecked_retval and the
+        # integer module watch STOP there) — means a lane-retired
+        # top-level STOP state can take the transaction-end shortcut
+        # (_fast_terminal) and its materialization can skip the
+        # stack/memory rebuild the STOP path never reads (lane_engine
+        # slim_stop)
+        slim_stop = (
+            not _essential(self.instr_pre_hook["STOP"])
+            and not _essential(self.instr_post_hook["STOP"])
+            and not _essential(self.pre_hooks.get("STOP", []))
+            and not _essential(self.post_hooks.get("STOP", []))
+        )
+
         for code, states in groups.items():
             # width right-sizing: args.tpu_lanes is the CAP; the engine
             # runs at the smallest bucket that fits this batch with
@@ -468,14 +499,15 @@ class LaserEVM:
             key = (code, width,
                    mesh.devices.size if mesh is not None else 0,
                    frozenset(blocked),
-                   tuple(id(a) for a in adapters))
+                   tuple(id(a) for a in adapters), slim_stop)
             try:
                 engine = cache.get(key)
                 if engine is None:
                     engine = LaneEngine(n_lanes=width,
                                         blocked_ops=blocked,
                                         adapters=adapters,
-                                        mesh=mesh)
+                                        mesh=mesh,
+                                        slim_stop=slim_stop)
                     cache[key] = engine
                     # keep at most two widths per code: drop the
                     # narrowest surplus engine (its pooled device
@@ -492,7 +524,17 @@ class LaserEVM:
                 self.work_list.extend(states)
                 continue
             run = engine.last_run_stats
-            self.work_list.extend(parked)
+            if slim_stop:
+                # transaction-end shortcut: lane-retired states parked
+                # at a top-level STOP skip the worklist round trip —
+                # see _fast_terminal (eligibility re-checked there;
+                # decliners requeue normally)
+                self.work_list.extend(
+                    gs for gs in parked
+                    if not self._fast_terminal(gs)
+                )
+            else:
+                self.work_list.extend(parked)
             self.total_states += run["device_steps"]
             # device-executed pcs are invisible to execute_state hooks;
             # merge the engine's visited bitmap into coverage consumers
@@ -610,6 +652,58 @@ class LaserEVM:
         for hook in self._stop_exec_hooks:
             hook()
         return final_states if track_gas else None
+
+    def _fast_terminal(self, global_state: GlobalState) -> bool:
+        """Transaction-end shortcut for a lane-retired state parked at
+        a top-level STOP when no essential hook watches STOP (on either
+        hook channel): replays exactly what execute_state's STOP path
+        does — execute_state hooks, both pre-hook channels (lane-safe
+        only, per the slim_stop gate), transaction_end hooks, the
+        PotentialIssue wave append, and _add_world_state — without the
+        worklist round trip, Instruction dispatch, or signal unwind
+        (stop_ raises before post hooks ever fire, so none are owed).
+        Returns False for ineligible states: the caller requeues them
+        on the normal path. The caller guarantees the essential-hook
+        check (sweep's slim_stop)."""
+        from .transaction import MessageCallTransaction
+
+        ms = global_state.mstate
+        ilist = global_state.environment.code.instruction_list
+        if ms.pc >= len(ilist) or ilist[ms.pc]["opcode"] != "STOP":
+            return False
+        tx_stack = global_state.transaction_stack
+        if not tx_stack or tx_stack[-1][1] is not None:
+            return False
+        transaction = tx_stack[-1][0]
+        if not isinstance(transaction, MessageCallTransaction):
+            return False
+
+        try:
+            for hook in self._execute_state_hooks:
+                hook(global_state)
+        except PluginSkipState:
+            return True
+        try:
+            self._execute_pre_hook("STOP", global_state)
+        except PluginSkipState:
+            return True
+        for hook in self.instr_pre_hook["STOP"]:
+            hook(global_state)
+        ms.prev_pc = ms.pc
+        # NO gas accounting or OOG check: stop_ raises the end signal
+        # inside the decorated function, before StateTransition's
+        # accumulate_gas/check_gas_usage_limit ever run — the real
+        # STOP path always ends the transaction normally
+
+        transaction.return_data = None
+        for hook in self._transaction_end_hooks:
+            hook(global_state, transaction, None, False)
+        global_state.world_state.node = global_state.node
+        self._pi_wave.append(global_state)
+        if len(self._pi_wave) >= 256:
+            self._discharge_pi_wave()
+        self._add_world_state(global_state)
+        return True
 
     @staticmethod
     def _record_fork_scale(code_obj, peak: int) -> None:
