@@ -224,7 +224,17 @@ def bench_symbolic(n_lanes=4096, trials=None):
     for bucket in (16, width):
         lane_engine.warm_variant(width, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
                                  seed_bucket=bucket, block=True)
+    import gc
+
     host_walls, lane_walls = [], []
+    # GC hygiene, SYMMETRIC like bench_config5's: freeze the warm-up
+    # survivors out of the old generation once, then run BOTH sides'
+    # trials under the same regime — each trial's own garbage stays in
+    # the young generations either way. Without this, full-heap GC
+    # walks over the accumulated cross-trial debris land arbitrarily
+    # inside single trials and swing them several-fold.
+    gc.collect()
+    gc.freeze()
     try:
         for _ in range(trials):
             host_s, host_paths = _explore(code, 0)
@@ -237,6 +247,7 @@ def bench_symbolic(n_lanes=4096, trials=None):
             assert lane_paths == host_paths, (lane_paths, host_paths)
     finally:
         lane_engine.FORCE_WIDTH = None
+        gc.unfreeze()
     from mythril_tpu.smt import repair
 
     stats = lane_engine.RUN_STATS_TOTAL
