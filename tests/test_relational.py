@@ -113,3 +113,57 @@ def test_agrees_with_cdcl():
         s = Solver()
         s.add(And(guard, profit))
         assert s.check() == unsat
+
+
+def test_fuzz_agreement_with_cdcl():
+    """Randomized soundness check: on random transfer-shaped systems
+    (guarded/unguarded outflows, bounded/unbounded inflows, constant
+    pins, ping-pongs) the refuter may only answer unsat when the CDCL
+    core agrees."""
+    import random
+
+    from mythril_tpu.smt import And
+    from mythril_tpu.smt.solver import Solver, sat
+
+    rng = random.Random(0xC0FFEE)
+    refuted = 0
+    for trial in range(40):
+        balances = Array("t_fz_bal_%d" % trial, 256, 256)
+        att = _attacker()
+        start = balances[att]
+        cons = []
+        n_ops = rng.randint(1, 4)
+        for j in range(n_ops):
+            kind = rng.randrange(4)
+            v = symbol_factory.BitVecSym(
+                "t_fz_v_%d_%d" % (trial, j), 256)
+            if kind == 0:  # guarded outflow
+                cons.append(UGE(balances[att], v))
+                balances[att] -= v
+            elif kind == 1:  # unguarded outflow (may wrap)
+                balances[att] -= v
+            elif kind == 2:  # unbounded inflow
+                balances[att] += v
+            else:  # inflow bounded by a fresh outflow
+                w = symbol_factory.BitVecSym(
+                    "t_fz_w_%d_%d" % (trial, j), 256)
+                cons.append(UGE(balances[att], w))
+                balances[att] -= w
+                cons.append(UGE(w, v))
+                balances[att] += v
+        profit = UGT(balances[att], start)
+        system = tuple(cons + [profit])
+        verdict = relational_unsat(system)
+        if not verdict:
+            continue
+        refuted += 1
+        s = Solver()
+        s.set_timeout(20000)
+        s.add(And(*system))
+        # only a definitive SAT is a soundness violation; unknown
+        # (timeout on a slow box) must not masquerade as one
+        assert s.check() != sat, (
+            "refuter claimed unsat on a satisfiable system", trial)
+    # the generator must actually produce refutable shapes, or the
+    # agreement check is vacuous
+    assert refuted >= 5
