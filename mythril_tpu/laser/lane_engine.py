@@ -1265,7 +1265,8 @@ def pick_mesh(width: int):
 
 
 def pick_width(cap: int, n_entries: int,
-               code: Optional[bytes] = None) -> int:
+               code: Optional[bytes] = None,
+               headroom: int = 8) -> int:
     """Engine width for a sweep: the smallest power-of-two bucket with
     generous fork headroom over the entry batch (and over the code's
     observed fork scale), bounded by the configured lane cap. The cap
@@ -1280,7 +1281,7 @@ def pick_width(cap: int, n_entries: int,
         return max(min(cap, FORCE_WIDTH), 1)
     if cap <= 64:
         return max(cap, 1)
-    demand = max(n_entries * 8,
+    demand = max(n_entries * headroom,
                  PATH_HISTORY.get(code, 0) if code else 0)
     want = 64
     while want < cap and want < demand:

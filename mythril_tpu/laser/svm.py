@@ -487,6 +487,24 @@ class LaserEVM:
             # on a tunneled backend), fall back to the widest warm
             # narrower bucket rather than to the host interpreter.
             width = pick_width(args.tpu_lanes, len(states), code)
+            if width > 64 and all(
+                s.mstate.pc != 0 for s in states
+            ):
+                # a wave of RESUMED mid-path states (spill/refill
+                # churn) sizes to the wave with fork headroom, not to
+                # the code's full fork-scale history: an overflowing
+                # tree's reseed waves ran ~1k live lanes on full-width
+                # planes (~3% occupancy) and paid the whole per-step
+                # width cost. If such a wave still forks wide it
+                # spills again and the NEXT wave grows geometrically —
+                # bounded churn. Routed through pick_width with
+                # code=None (history ignored — that IS the intent) so
+                # bucket rounding and FORCE_WIDTH pinning stay in one
+                # place; halved headroom because resumed states mostly
+                # run OUT rather than fan out.
+                width = min(width,
+                            pick_width(args.tpu_lanes, len(states),
+                                       headroom=4))
             while width > 64 and not warm_variant(
                     width, len(code), {},
                     DEFAULT_WINDOW, DEFAULT_STEP_BUDGET):
