@@ -112,9 +112,15 @@ _signed = T._signed
 class _Repairer:
     """One repair attempt of one query against one donor model."""
 
+    #: force-call budget per attempt: ITE branch flipping explores two
+    #: avenues per node, so deep read-over-write chains could otherwise
+    #: go exponential — repair is an optimization, cap and bail
+    _FORCE_BUDGET = 4096
+
     def __init__(self, md: ModelData):
         self.md = md
         self.reqs: Dict[_Cell, Tuple[int, int]] = {}
+        self._budget = self._FORCE_BUDGET
 
     # -- donor-model evaluation (best-effort) -----------------------------
 
@@ -143,6 +149,9 @@ class _Repairer:
         val &= mask
         if mask == 0:
             return True
+        self._budget -= 1
+        if self._budget <= 0:
+            return False
         op = t.op
         if op == T.BV_CONST:
             return (t.val & mask) == val
@@ -308,6 +317,9 @@ class _Repairer:
     def lit(self, t: "T.Term", want: bool) -> bool:
         """Derive cell requirements that make boolean term `t` evaluate
         to `want`."""
+        self._budget -= 1
+        if self._budget <= 0:
+            return False  # shared with force(): both explore branches
         op = t.op
         if op == T.NOT:
             return self.lit(t.args[0], not want)
