@@ -51,7 +51,8 @@ MAX_FAILED = 48
 
 #: repair effectiveness counters (read by bench detail)
 STATS = {"attempts": 0, "repaired": 0,
-         "verify_skipped": 0, "verify_evaled": 0}
+         "verify_skipped": 0, "verify_evaled": 0,
+         "budget_exhausted": 0}
 
 #: conjunct tid -> frozenset of read-cell keys, or None when the term
 #: contains structure the extractor does not model (always re-verify).
@@ -112,10 +113,15 @@ _signed = T._signed
 class _Repairer:
     """One repair attempt of one query against one donor model."""
 
-    #: force-call budget per attempt: ITE branch flipping explores two
-    #: avenues per node, so deep read-over-write chains could otherwise
-    #: go exponential — repair is an optimization, cap and bail
-    _FORCE_BUDGET = 4096
+    #: force/lit call budget per attempt: branch-flipping handlers
+    #: (ITE arms, BAND/arith avenue retries, OR/AND literal arms)
+    #: explore two avenues per node, so deep chains could otherwise go
+    #: exponential — repair is an optimization, cap and bail. Priced
+    #: generously against LINEAR traversal (a 256-byte concat walk is
+    #: ~257 calls; 16 failed conjuncts of that shape stay well inside),
+    #: while an exponential blowup still dies in milliseconds;
+    #: STATS["budget_exhausted"] records every capped attempt.
+    _FORCE_BUDGET = 65536
 
     def __init__(self, md: ModelData):
         self.md = md
@@ -151,6 +157,8 @@ class _Repairer:
             return True
         self._budget -= 1
         if self._budget <= 0:
+            if self._budget == 0:
+                STATS["budget_exhausted"] += 1
             return False
         op = t.op
         if op == T.BV_CONST:
@@ -319,6 +327,8 @@ class _Repairer:
         to `want`."""
         self._budget -= 1
         if self._budget <= 0:
+            if self._budget == 0:
+                STATS["budget_exhausted"] += 1
             return False  # shared with force(): both explore branches
         op = t.op
         if op == T.NOT:

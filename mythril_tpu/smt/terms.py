@@ -326,6 +326,19 @@ def mk_and(a: Term, b: Term) -> Term:
                 return bv_const(0, a.width)
             if x.val == _mask(a.width):
                 return y
+            # fold nested constant masks: band(c1, band(c2, t)) ==
+            # band(c1 & c2, t). The EVM's address-masking idiom stacks
+            # masks (every AND with 2^160-1 re-masks the same select),
+            # and without this fold two semantically identical
+            # conditions intern to DIFFERT tids — defeating every
+            # tid-equality screen downstream (dedup, repair cells, the
+            # relational refuter's case consistency)
+            if y.op == BAND:
+                for u, v in ((y.args[0], y.args[1]),
+                             (y.args[1], y.args[0])):
+                    if is_const(u):
+                        return mk_and(bv_const(x.val & u.val, a.width),
+                                      v)
     if a is b:
         return a
     a, b = _sort2(a, b)
