@@ -267,6 +267,16 @@ def bench_symbolic(n_lanes=4096, trials=None):
             "windows": stats.get("windows"),
             "sha3_resumed_in_place": stats.get("resumed"),
             "model_repairs": dict(repair.STATS),
+            # drain-pipeline overlap (docs/drain_pipeline.md): idle =
+            # device drained while the host ran the serial drain; busy
+            # = host work hidden behind device execution; wait = host
+            # blocked on the fused window pull
+            "overlap": {
+                k: stats.get(k, 0)
+                for k in ("overlap_idle_ms", "overlap_busy_ms",
+                          "device_wait_ms", "overlap_mat",
+                          "overlap_mat_ms")
+            },
         },
     }
 
@@ -293,6 +303,7 @@ def _analyze_fixture(path, timeout, tx_count, tpu_lanes):
     q0, t0s = ss.query_count, ss.solver_time
     p0 = dict(pruner.STATS)
     s0 = dict(SCREEN_STATS)
+    b0 = dict(ss.batch_counters())
     disassembler = MythrilDisassembler(eth=None)
     address, _ = disassembler.load_from_bytecode(
         path.read_text().strip(), bin_runtime=True)
@@ -326,6 +337,10 @@ def _analyze_fixture(path, timeout, tx_count, tpu_lanes):
         "queries_screened": SCREEN_STATS["screened"] - s0["screened"],
         "queries_proved_unsat": SCREEN_STATS["proved_unsat"]
         - s0["proved_unsat"],
+        "solver_batch": {
+            k: round(v - b0.get(k, 0), 1)
+            for k, v in ss.batch_counters().items()
+        },
     }
 
 
@@ -723,6 +738,100 @@ def bench_config4(timeout=60, lanes=4096):
     }
 
 
+def bench_smoke():
+    """`bench.py --smoke`: CI-fast (<60 s on this box) visibility run
+    for the drain pipeline and the batched feasibility discharge — NO
+    full corpus sweep. Two stages:
+
+    1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
+       engine with fork pruning engaged, so the window-pipeline overlap
+       counters (overlap_idle/busy, device_wait) and the overlapped
+       fork screen (fork_screened/fork_killed) exercise for real;
+    2. a batched `check_batch` discharge over fork-sibling constraint
+       sets (shared prefixes, a contradiction, and its superset), so
+       prefix-dedup and subset-kill provably count.
+
+    Prints ONE JSON line with the counter deltas; a perf regression in
+    the discharge layer shows up as zeroed counters (or a solve-call
+    count equal to the query count) without waiting on a corpus sweep."""
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.support_args import args as sargs
+
+    ss = SolverStatistics()
+    out = {"metric": "smoke (drain pipeline + batched discharge)",
+           "unit": "counters", "value": 1}
+    c0 = dict(ss.batch_counters())
+
+    # stage 1: tiny lane explore, fork screen on. 2^8 paths through 64
+    # lanes: fork pressure makes the explore span several windows, so
+    # the drain pipeline (and the overlapped screen) actually cycles
+    code, n_paths = build_symbolic_contract(k=8)
+    lane_engine.PATH_HISTORY[code] = n_paths
+    lane_engine.FORCE_WIDTH = 64
+    old_pf = sargs.pruning_factor
+    sargs.pruning_factor = 1.0
+    # short windows: lanes must still be RUNNING at a window boundary
+    # for the overlapped fork screen to have anything to discharge (at
+    # the default 256-step window this contract's paths park within
+    # one window and the screen never collects)
+    old_window = lane_engine.DEFAULT_WINDOW
+    lane_engine.DEFAULT_WINDOW = 32
+    try:
+        lane_engine.warm_variant(
+            64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        lane_engine.RUN_STATS_TOTAL = {}
+        wall, paths = _explore(code, 64)
+        eng = lane_engine.RUN_STATS_TOTAL
+        out["lane"] = {
+            "wall_s": round(wall, 2), "paths": paths,
+            "windows": eng.get("windows", 0),
+            "overlap_idle_ms": eng.get("overlap_idle_ms", 0),
+            "overlap_busy_ms": eng.get("overlap_busy_ms", 0),
+            "device_wait_ms": eng.get("device_wait_ms", 0),
+            "overlap_solve_ms": eng.get("overlap_solve_ms", 0),
+            "fork_screened": eng.get("fork_screened", 0),
+            "fork_killed": eng.get("fork_killed", 0),
+        }
+    except Exception as e:  # counters still print from stage 2
+        out["lane"] = {"error": type(e).__name__, "detail": str(e)[:200]}
+    finally:
+        lane_engine.FORCE_WIDTH = None
+        lane_engine.DEFAULT_WINDOW = old_window
+        sargs.pruning_factor = old_pf
+
+    # stage 2: batched discharge over sibling sets (the check_batch
+    # seam svm's open-state screen and the fork pruner route through)
+    from mythril_tpu.laser.state.constraints import Constraints
+    from mythril_tpu.smt import ULE, ULT, symbol_factory
+    from mythril_tpu.support.model import check_batch
+
+    BV = lambda v: symbol_factory.BitVecVal(v, 256)  # noqa: E731
+    x = symbol_factory.BitVecSym("smoke_x", 256)
+    y = symbol_factory.BitVecSym("smoke_y", 256)
+    prefix = [ULE(BV(16), x), ULE(x, BV(4096))]
+    sets = []
+    for j in range(12):
+        sets.append(Constraints(prefix + [ULE(y, x + BV(j))]))
+    contra = Constraints([ULT(x, BV(4)), ULE(BV(9), x)])
+    sets.append(contra)
+    for j in range(4):
+        sets.append(Constraints(list(contra) + [ULE(y, BV(j))]))
+    verdicts = check_batch(sets)
+    out["batch_verdicts"] = {"possible": sum(verdicts),
+                             "killed": len(verdicts) - sum(verdicts)}
+    out["solver_batch"] = {
+        k: round(v - c0.get(k, 0), 1)
+        for k, v in ss.batch_counters().items()
+    }
+    print(json.dumps(out), flush=True)
+    ok = (out["solver_batch"]["subset_kills"] > 0
+          and out["solver_batch"]["batch_solve_calls"]
+          < out["solver_batch"]["batch_queries"])
+    return 0 if ok else 1
+
+
 def _enable_compile_cache():
     """Persist XLA compilations across bench runs — EXCEPT on the
     tunneled axon backend, where support/devices.enable_compile_cache
@@ -806,7 +915,7 @@ def main():
 
 
 if __name__ == "__main__":
-    rc = main()
+    rc = bench_smoke() if "--smoke" in sys.argv[1:] else main()
     # hard exit: the tunneled axon client can throw from a background
     # thread during interpreter teardown ("terminate called ...",
     # SIGABRT) AFTER all results are printed — skip destructors so the

@@ -1293,12 +1293,14 @@ class LaneEngine:
     """Owns one lane batch + object table for a single contract's
     exploration."""
 
-    def __init__(self, n_lanes: int = 256, window: int = DEFAULT_WINDOW,
+    def __init__(self, n_lanes: int = 256, window: Optional[int] = None,
                  step_budget: int = DEFAULT_STEP_BUDGET,
                  blocked_ops=None, adapters=None, mesh=None,
                  slim_stop: bool = False, **lane_kwargs):
         self.n_lanes = n_lanes
-        self.window = window
+        # resolve at call time: bench.py --smoke (and tests) retune the
+        # module-level DEFAULT_WINDOW before svm builds the engine
+        self.window = DEFAULT_WINDOW if window is None else window
         self.step_budget = step_budget
         self.lane_kwargs = lane_kwargs
         #: svm guarantees no essential hook watches STOP: lanes parked
@@ -1375,6 +1377,13 @@ class LaneEngine:
             "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
             "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
             "resumed": 0, "overlap_mat": 0, "overlap_mat_ms": 0,
+            # window-pipeline overlap (docs/drain_pipeline.md):
+            # host-visible device idle (pull-complete -> next dispatch),
+            # host work overlapped with device execution, host blocked
+            # on the fused window pull, and the batched fork screen
+            "overlap_idle_ms": 0, "overlap_busy_ms": 0,
+            "device_wait_ms": 0, "overlap_solve_ms": 0,
+            "fork_screened": 0, "fork_killed": 0,
         }
         # in-place SHA3 resume: off whenever a detector hooks SHA3
         # (the hook must fire host-side; no adapter lifts SHA3 today)
@@ -2219,6 +2228,50 @@ class LaneEngine:
         self.stats["parked"] += 1
         return gs
 
+    # -- overlapped fork-feasibility screening -------------------------------
+
+    def _screen_forks(self, queries, registry):
+        """Batched feasibility discharge for still-running forked
+        lanes' condition prefixes (smt/solver/batch.py): runs in the
+        OVERLAPPED phase — the device is already executing the next
+        window — so the solver work that used to serialize behind the
+        drain now hides behind device execution. Returns the lanes
+        whose prefix is provably UNSAT; they join the next dispatch's
+        kill list. Sound: only proved-infeasible paths die (the same
+        guarantee as the host's prune_feasible_states, and engaged
+        under the same args.pruning_factor gate — the default-off host
+        policy keeps lane/host path counts identical by default).
+        Screening a lane's conds WITHOUT the keccak axioms is sound
+        for killing: an UNSAT subset implies an UNSAT superset."""
+        from ..smt import Model
+        from ..smt.solver import batch as solver_batch
+        from ..support.model import model_cache
+
+        term_sets = [[c.raw for c in conds] for _, conds in queries]
+
+        def quick_sat(conj):
+            return model_cache.check_quick_sat(conj)
+
+        def on_sat_model(md):
+            # feed the shared ModelCache: sibling lanes (and later
+            # open-state screens) quick-sat against this model
+            model_cache.put(Model([md]), 1)
+
+        t0 = time.perf_counter()
+        try:
+            verdicts = solver_batch.discharge(
+                term_sets, timeout_s=2.0, conflict_budget=16384,
+                quick_sat=quick_sat, on_sat_model=on_sat_model,
+                registry=registry)
+        except Exception as e:  # a screen, never an error path
+            log.debug("fork-feasibility screen failed: %s", e)
+            return []
+        self.stats["overlap_solve_ms"] += int(
+            (time.perf_counter() - t0) * 1000)
+        self.stats["fork_screened"] += len(queries)
+        return [lane for (lane, _), v in zip(queries, verdicts)
+                if v == solver_batch.UNSAT]
+
     # -- top-level loop ------------------------------------------------------
 
     def explore(self, code_bytes: bytes,
@@ -2269,24 +2322,53 @@ class LaneEngine:
         resumes: List[tuple] = []
         small = min(16, self.n_lanes)
         peak_demand = len(queue)
-        # one-deep materialization pipeline: GlobalState rebuilds for
-        # window k's retired lanes run AFTER window k+1 is dispatched,
-        # overlapping the host's biggest per-window cost with device
-        # execution. Flushed before window k+1's drain — materialize
-        # resolves this window's provisional sids through self._prov,
-        # which the next drain overwrites.
+        # one-deep drain pipeline (double-buffered windows): window k's
+        # retire-row PULL and the GlobalState rebuilds for its retired
+        # lanes run AFTER window k+1 is dispatched, overlapping the
+        # host's biggest per-window costs (transfer + materialize) with
+        # device execution. Each entry is (rows, floors, items): rows
+        # is a host dict when already pulled or the device arrays of a
+        # deferred escalation retire (floors says how to unpack);
+        # items = [(row index, ctx)]. Flushed before window k+1's
+        # drain — materialize resolves this window's provisional sids
+        # through self._prov, which the next drain overwrites.
         pending_mat: List[tuple] = []
 
         def _flush_pending() -> None:
             if not pending_mat:
                 return
             t0 = time.perf_counter()
-            for rows_host, row, ctx in pending_mat:
-                results.append(self.materialize(rows_host, row, ctx))
-            self.stats["overlap_mat"] += len(pending_mat)
+            n_mat = 0
+            for rows_ref, floors, items in pending_mat:
+                if floors is not None:  # deferred device rows
+                    with _prof("retire_pull"):
+                        rows_ref = _unpack_rows(
+                            jax.device_get(rows_ref), *floors)
+                for row, ctx in items:
+                    results.append(self.materialize(rows_ref, row, ctx))
+                    n_mat += 1
+            self.stats["overlap_mat"] += n_mat
             self.stats["overlap_mat_ms"] += int(
                 (time.perf_counter() - t0) * 1000)
             pending_mat.clear()
+
+        # overlapped fork-feasibility screening (batched discharge,
+        # gated like the host's fork pruning): queries collected at
+        # drain k discharge while window k+1 executes; UNSAT lanes
+        # ride the kill list of dispatch k+2
+        from ..smt.solver.solver_statistics import SolverStatistics
+        from ..support.support_args import args as _args
+
+        _solver_stats = SolverStatistics()
+        screen_on = bool(getattr(_args, "pruning_factor", None))
+        screen_registry = None
+        if screen_on:
+            from ..smt.solver.batch import SubsetRegistry
+
+            screen_registry = SubsetRegistry()
+        pending_screen: List[tuple] = []
+        screen_dead: List[int] = []
+        t_idle0 = None
         try:
             while True:
                 # a seed backlog beyond the small bucket drains in ONE
@@ -2316,12 +2398,29 @@ class LaneEngine:
                 resumes = []
                 n_free_written = len(free)
                 _tw = time.perf_counter() if PROF_ON else 0.0
+                if t_idle0 is not None:
+                    # host-visible device idle: from the previous
+                    # window's pull completing (device drained) to this
+                    # dispatch being enqueued — the serial drain wall
+                    # the pipeline exists to shrink
+                    idle_ms = (time.perf_counter() - t_idle0) * 1000
+                    self.stats["overlap_idle_ms"] += int(idle_ms)
+                    _solver_stats.overlap_idle_ms += idle_ms
+                    t_idle0 = None
                 with _prof("window_exec", sync=lambda: st.pc):
                     st, visited, out = _window_exec(
                         st, cc, i32buf, u8buf, self.exec_table,
                         self.taint_table, self.window, k,
                         self.step_budget, pv, visited,
                         self._resume_flag)
+                # start the fused outputs' D2H copies now: the transfer
+                # overlaps the host work below instead of serializing
+                # into the blocking pull
+                for arr in out:
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:
+                        break  # backend without async copies
                 # the kill landed at the dispatch's reset phase: only now
                 # may the slots be recycled (they enter the free stack the
                 # device sees at the NEXT dispatch)
@@ -2329,18 +2428,32 @@ class LaneEngine:
                     ctxs[lane] = None
                     free.append(lane)
                 kill = []
-                # the dispatch above is asynchronous: rebuild the LAST
-                # window's retired GlobalStates while this one executes
+                # the dispatch above is asynchronous: while this window
+                # executes, pull+rebuild the LAST window's retired
+                # GlobalStates and discharge its fork-feasibility batch
+                t_busy0 = time.perf_counter()
                 _flush_pending()
+                if pending_screen:
+                    screen_dead = self._screen_forks(pending_screen,
+                                                     screen_registry)
+                    pending_screen = []
+                busy_ms = (time.perf_counter() - t_busy0) * 1000
+                self.stats["overlap_busy_ms"] += int(busy_ms)
+                _solver_stats.overlap_busy_ms += busy_ms
                 if PROF_ON:
                     PROF.setdefault("windows", []).append(  # type: ignore
                         (round(time.perf_counter() - _tw, 3), k,
                          len(code_bytes), self.n_lanes))
                 self.stats["windows"] += 1
+                t_wait0 = time.perf_counter()
                 with _prof("window_pull"):
                     (misc, scal, utab, ftab, ridx, r_i32, r_u32,
                      r_u8, hidx, h_i32, h_u32, h_u8) = [
                         np.asarray(x) for x in jax.device_get(out)]
+                wait_ms = (time.perf_counter() - t_wait0) * 1000
+                self.stats["device_wait_ms"] += int(wait_ms)
+                _solver_stats.device_wait_ms += wait_ms
+                t_idle0 = time.perf_counter()
                 counts_h = {
                     "dlog_count": misc[:, 0], "status": misc[:, 1],
                     "steps": misc[:, 2], "sp": misc[:, 3],
@@ -2480,22 +2593,34 @@ class LaneEngine:
                     idx_arr[: len(lanes_sel)] = lanes_sel
                     return idx_arr
 
-                def _materialize_rows(lanes_sel, rows_host,
-                                      defer=False):
+                def _materialize_rows(lanes_sel, rows_host):
                     with _prof("materialize"):
                         for row, lane in enumerate(lanes_sel):
                             self.stats["device_steps"] += \
                                 int(steps[lane])
                             if lane not in dead_set:
-                                if defer:
-                                    pending_mat.append(
-                                        (rows_host, row, ctxs[lane]))
-                                else:
-                                    results.append(self.materialize(
-                                        rows_host, row, ctxs[lane]))
+                                results.append(self.materialize(
+                                    rows_host, row, ctxs[lane]))
                             ctxs[lane] = None
                             free.append(lane)
                     status[np.asarray(lanes_sel, np.int32)] = DEAD
+
+                def _defer_rows(lanes_sel, rows_ref, floors_sel):
+                    """Queue retired lanes for the pipelined flush: the
+                    slots free NOW (the device already marked the rows
+                    DEAD before any later dispatch can re-seed them);
+                    the row transfer + GlobalState rebuild run after
+                    the NEXT window is dispatched. ctx refs snapshot
+                    here — the slot may be re-seeded before the flush."""
+                    items = []
+                    for row, lane in enumerate(lanes_sel):
+                        self.stats["device_steps"] += int(steps[lane])
+                        if lane not in dead_set:
+                            items.append((row, ctxs[lane]))
+                        ctxs[lane] = None
+                        free.append(lane)
+                    status[np.asarray(lanes_sel, np.int32)] = DEAD
+                    pending_mat.append((rows_ref, floors_sel, items))
 
                 rows = None
                 if rest:
@@ -2546,14 +2671,16 @@ class LaneEngine:
                             self.stats["device_steps"] += int(steps[lane])
                             if lane not in dead_set:
                                 pending_mat.append(
-                                    (st_fast, row, ctxs[lane]))
+                                    (st_fast, None,
+                                     [(row, ctxs[lane])]))
                             ctxs[lane] = None
                             free.append(lane)
                 if rest:
-                    with _prof("retire_pull"):
-                        st_host = _unpack_rows(jax.device_get(rows),
-                                               *floors)
-                    _materialize_rows(rest, st_host, defer=True)
+                    # pipelined: the escalation rows' pull rides the
+                    # NEXT window's execution (the gather itself was
+                    # dispatched before the drain and is ordered ahead
+                    # of any re-seed by the st dependency chain)
+                    _defer_rows(rest, rows, floors)
                 if declined:
                     # rare: held lanes the host would not resume
                     # (symbolic length, OOG, oversize, trivially-false
@@ -2575,6 +2702,35 @@ class LaneEngine:
                 for lane in dead:
                     if lane not in retired:
                         kill.append(lane)
+                # solver-killed lanes from the overlapped fork screen:
+                # proved-UNSAT prefixes die at the next dispatch, same
+                # protocol as trivially-false lanes. A lane that parked
+                # or died in the meantime is skipped (its state already
+                # materialized; the open-state screen prunes it later).
+                for lane in screen_dead:
+                    if (lane not in retired and lane not in dead_set
+                            and status[lane] == Status.RUNNING
+                            and ctxs[lane] is not None
+                            and lane not in kill):
+                        kill.append(lane)
+                        self.stats["fork_killed"] += 1
+                screen_dead = []
+                # collect the NEXT overlapped screen batch: lanes that
+                # gained path conditions this window and are still
+                # running (their descendants subset-kill through the
+                # per-explore registry once a prefix is refuted)
+                if screen_on and forks:
+                    touched = sorted({f[1] for f in forks}
+                                     | {f[2] for f in forks})
+                    pending_screen = [
+                        (lane, [c for (_, c) in ctxs[lane].conds])
+                        for lane in touched
+                        if (status[lane] == Status.RUNNING
+                            and lane not in dead_set
+                            and lane not in kill
+                            and ctxs[lane] is not None
+                            and ctxs[lane].conds)
+                    ][:256]
 
                 # width-demand sample: lanes concurrently occupied plus
                 # entries still queued for a slot (what a wide-enough
@@ -2585,9 +2741,7 @@ class LaneEngine:
                 if not running and not queue:
                     break
             # the last window has no successor dispatch to hide behind
-            for rows_host, row, ctx in pending_mat:
-                results.append(self.materialize(rows_host, row, ctx))
-            pending_mat.clear()
+            _flush_pending()
         finally:
             # an exception mid-sweep (svm falls back to the host)
             # must not lose coverage accumulated in prior windows;

@@ -75,3 +75,16 @@ class BenchmarkPlugin(LaserPlugin):
             self.nr_of_executed_insns,
             self.nr_of_executed_insns / duration if duration else 0.0,
         )
+        # batched-discharge + drain-pipeline counters
+        # (docs/drain_pipeline.md): process-cumulative, so the sweep's
+        # own contribution is the delta since the run began — still the
+        # right visibility signal for "did the batch layer engage"
+        try:
+            from ....smt.solver.solver_statistics import (
+                SolverStatistics,
+            )
+
+            log.info("Solver batch/pipeline: %s",
+                     SolverStatistics().batch_counters())
+        except Exception:  # telemetry only, never an error path
+            pass

@@ -70,6 +70,15 @@ class InstructionProfiler(LaserPlugin):
         lines = [
             "Total: {} s".format(total),
         ]
+        try:
+            from ....smt.solver.solver_statistics import (
+                SolverStatistics,
+            )
+
+            lines.append("Solver batch/pipeline: {}".format(
+                SolverStatistics().batch_counters()))
+        except Exception:  # telemetry only
+            pass
         for r in sorted(
             self.records.values(), key=lambda x: -x.total_time
         ):

@@ -287,6 +287,16 @@ class LaserEVM:
                 open_states = prefilter_world_states(open_states)
             except Exception as e:  # never let the fast path break the run
                 log.debug("TPU prefilter unavailable: %s", e)
+        if len(open_states) > 1:
+            # batched discharge: sibling open states share long
+            # constraint prefixes (they forked from common JUMPIs), so
+            # one trie-ordered pass over the incremental session
+            # replaces per-state from-scratch solves; verdict semantics
+            # are identical to is_possible (support/model.check_batch)
+            from ..support.model import check_batch
+
+            keep = check_batch([s.constraints for s in open_states])
+            return [s for s, ok in zip(open_states, keep) if ok]
         return [
             state for state in open_states
             if state.constraints.is_possible()
@@ -651,7 +661,13 @@ class LaserEVM:
                     # count, so a fork storm pays O(log) full walks
                     # even when another code floods the list
                     length = len(self.work_list)
-                    if length > max(2 * last_len, last_len + 32):
+                    # first multi-fork event always counts (last_len ==
+                    # 0): codes whose worklist never exceeds 32 states
+                    # otherwise record no fork scale at all and
+                    # pick_width sees no history for them (ADVICE.md);
+                    # afterwards the geometric schedule bounds re-counts
+                    if last_len == 0 \
+                            or length > max(2 * last_len, last_len + 32):
                         peak = sum(
                             1 for s in self.work_list
                             if s.environment.code is code_obj

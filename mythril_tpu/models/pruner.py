@@ -173,6 +173,21 @@ def prune_feasible_states(states: List) -> List:
     survivors = _screen_interval(
         states,
         lambda s: _all_constraints(s.world_state.constraints))
+    from ..laser.state.constraints import Constraints
+
+    if len(survivors) > 1 and all(
+        isinstance(s.world_state.constraints, Constraints)
+        for s in survivors
+    ):
+        # fork siblings share their constraint prefix by construction:
+        # the batched discharge asserts it once and subset-kills
+        # UNSAT supersets (support/model.check_batch; is_possible
+        # semantics preserved, including timeout-means-possible)
+        from ..support.model import check_batch
+
+        keep = check_batch(
+            [s.world_state.constraints for s in survivors])
+        return [s for s, ok in zip(survivors, keep) if ok]
     return [
         s for s in survivors
         if s.world_state.constraints.is_possible()

@@ -33,7 +33,14 @@ class Expression(Generic[G]):
     @property
     def annotations(self) -> Set:
         ann = self._annotations
-        return ann if ann is not None else set()
+        if ann is None:
+            # materialize on access: returning a throwaway empty set
+            # silently dropped `expr.annotations.add(x)` on annotation-
+            # free expressions (the lazy slot stayed None); the lazy
+            # win is preserved for wrappers whose annotations are never
+            # read
+            ann = self._annotations = set()
+        return ann
 
     @annotations.setter
     def annotations(self, value) -> None:

@@ -261,10 +261,20 @@ def _discharge_case(s_tid: int, delta: Dict[int, int], guards, mu,
 
     # chain the full outflow multiset from start
     def expect(used: Dict[int, int]) -> Dict[int, int]:
+        # MERGE coefficients (dropping zeros): when the start atom is
+        # itself consumed as an outflow, its +1 start coefficient must
+        # combine to 1-n — overwriting it (e[tid] = -n) made a guard of
+        # the form `v <= 0 - start` match as if it proved
+        # `v <= start - start`, and relational_unsat then declared
+        # satisfiable sets UNSAT (ADVICE.md high; regression in
+        # tests/test_relational.py)
         e = {s_tid: 1}
         for tid, n in used.items():
-            if n:
-                e[tid] = -n
+            nc = e.get(tid, 0) - n
+            if nc:
+                e[tid] = nc
+            else:
+                e.pop(tid, None)
         return e
 
     def search(remaining: Dict[int, int], used: Dict[int, int]) -> bool:

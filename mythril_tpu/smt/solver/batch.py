@@ -1,0 +1,186 @@
+"""Batched, shared-prefix incremental feasibility discharge.
+
+Sibling path-feasibility queries forked from one JUMPI share long
+constraint prefixes — the engine's drain sites and open-state screens
+re-discharge near-identical conjunctions thousands of times per
+analysis, and solving each superset independently is pure waste (the
+word-level incremental lever PolySAT and Bitwuzla's incremental track
+exploit; PAPERS.md). This module turns a WAVE of feasibility queries
+into one pass over the shared incremental session (core._IncrementalSession):
+
+1. queries sort in trie order — shortest constraint set first, then
+   lexicographic by constraint tid — so every strict subset discharges
+   before its supersets and shared prefixes become adjacent;
+2. each constraint term blasts AT MOST ONCE per process (the session's
+   `_prepared` map); terms a query shares with any earlier query are
+   prefix-dedup hits, not re-encodings;
+3. an UNSAT verdict records the query's constraint-tid set: any later
+   query whose set is a superset is UNSAT by monotonicity of
+   conjunction, WITHOUT a solve (subset-kill). The session-level
+   unsat-core subsumption additionally covers cross-batch repeats;
+4. a SAT model is handed to the caller (`on_sat_model`) — fed into the
+   ModelCache, it quick-sat-serves sibling queries before any fresh
+   solve (`quick_sat`).
+
+Verdicts are exactly the core's (SAT/UNSAT/UNKNOWN); soundness is
+inherited — subset-kill only ever strengthens a proved-UNSAT set.
+Counters land in SolverStatistics (solver_statistics.py) and surface
+through the benchmark/instruction-profiler plugins and bench.py.
+"""
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+from .. import terms as T
+from . import core
+from .solver_statistics import SolverStatistics
+
+SAT, UNSAT, UNKNOWN = core.SAT, core.UNSAT, core.UNKNOWN
+
+log = logging.getLogger(__name__)
+
+#: recorded UNSAT tid-sets per registry (screens are O(sets) per query)
+_REGISTRY_CAP = 512
+
+
+def tid_key(terms: Sequence["T.Term"]) -> tuple:
+    return tuple(t.tid for t in terms)
+
+
+def order_by_prefix(term_sets: Sequence[Sequence]) -> List[int]:
+    """Indices in trie order: shortest set first, lexicographic by
+    constraint tid within a length. A strict subset has strictly fewer
+    constraints, so it always discharges before its supersets (the
+    subset-kill invariant); equal-length sets sharing a prefix become
+    adjacent, so the incremental session re-blasts nothing shared."""
+    keys = [tid_key(ts) for ts in term_sets]
+    return sorted(range(len(term_sets)),
+                  key=lambda i: (len(keys[i]), keys[i]))
+
+
+def count_prepared(terms: Sequence["T.Term"]) -> int:
+    """How many distinct terms of this query the shared incremental
+    session has already blasted — each is a prefix-dedup hit: its
+    Tseitin clauses (and Ackermann axioms) are reused, not re-encoded."""
+    sess = core._session
+    if sess is None:
+        return 0
+    seen = set()
+    hits = 0
+    for t in terms:
+        if t.tid in seen:
+            continue
+        seen.add(t.tid)
+        if t.tid in sess._prepared:
+            hits += 1
+    return hits
+
+
+class SubsetRegistry:
+    """Verdict propagation across a batch (or across the windows of one
+    lane-engine explore): UNSAT constraint-tid sets kill every superset
+    without a solve; SAT sets answer every subset without a solve."""
+
+    def __init__(self):
+        self._unsat: List[frozenset] = []
+        self._sat: List[frozenset] = []
+
+    def unsat_superset(self, tids: frozenset) -> bool:
+        return any(u <= tids for u in self._unsat)
+
+    def sat_subset(self, tids: frozenset) -> bool:
+        return any(tids <= s for s in self._sat)
+
+    def note_unsat(self, tids: frozenset) -> None:
+        if tids not in self._unsat:
+            self._unsat.append(tids)
+            del self._unsat[:-_REGISTRY_CAP]
+
+    def note_sat(self, tids: frozenset) -> None:
+        if tids not in self._sat:
+            self._sat.append(tids)
+            del self._sat[:-_REGISTRY_CAP]
+
+
+def discharge(
+    term_sets: Sequence[Sequence["T.Term"]],
+    timeout_s: float = 2.0,
+    conflict_budget: int = 0,
+    quick_sat: Optional[Callable] = None,
+    on_sat_model: Optional[Callable] = None,
+    registry: Optional[SubsetRegistry] = None,
+) -> List[str]:
+    """Verdicts (SAT/UNSAT/UNKNOWN) for a batch of raw-term
+    conjunctions, in input order.
+
+    `quick_sat(conjunction_term)` returns a truthy value when a cached
+    model already satisfies the query (the ModelCache seam — the caller
+    supplies it so this module stays below the support layer);
+    `on_sat_model(model_data)` receives each fresh SAT model so the
+    caller can feed the cache for the remaining siblings. `registry`
+    persists subset/superset verdicts across calls (one lane-engine
+    explore screens many windows against the same prefix tree)."""
+    ss = SolverStatistics()
+    n = len(term_sets)
+    if not n:
+        return []
+    ss.batch_count += 1
+    ss.batch_queries += n
+    if registry is None:
+        registry = SubsetRegistry()
+    verdicts: List[Optional[str]] = [None] * n
+
+    # constant-fold screen + normalized per-query term list
+    norm: List[list] = []
+    for i, ts in enumerate(term_sets):
+        work = [t for t in ts if t.op != T.TRUE]
+        if any(t.op == T.FALSE for t in work):
+            verdicts[i] = UNSAT
+            work = []
+        norm.append(work)
+
+    for i in order_by_prefix(norm):
+        if verdicts[i] is not None:
+            continue
+        work = norm[i]
+        if not work:
+            verdicts[i] = SAT
+            continue
+        tids = frozenset(t.tid for t in work)
+        if registry.unsat_superset(tids):
+            ss.subset_kills += 1
+            verdicts[i] = UNSAT
+            continue
+        if registry.sat_subset(tids):
+            ss.sat_subsumed += 1
+            verdicts[i] = SAT
+            continue
+        if quick_sat is not None:
+            try:
+                if quick_sat(T.mk_bool_and(*work)):
+                    ss.quick_sat_hits += 1
+                    registry.note_sat(tids)
+                    verdicts[i] = SAT
+                    continue
+            except Exception:  # a cache probe, never an error path
+                pass
+        ss.prefix_dedup_hits += count_prepared(work)
+        ss.batch_solve_calls += 1
+        try:
+            ctx = core.check(list(work), timeout_s=timeout_s,
+                             conflict_budget=conflict_budget)
+        except Exception as e:  # degraded, never wrong: keep the query
+            log.debug("batch discharge solve failed: %s", e)
+            verdicts[i] = UNKNOWN
+            continue
+        verdicts[i] = ctx.status
+        if ctx.status == UNSAT:
+            registry.note_unsat(tids)
+        elif ctx.status == SAT:
+            registry.note_sat(tids)
+            if on_sat_model is not None and ctx.model is not None:
+                try:
+                    on_sat_model(ctx.model)
+                except Exception:
+                    pass
+    return [v if v is not None else UNKNOWN for v in verdicts]

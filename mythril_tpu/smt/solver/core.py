@@ -505,7 +505,33 @@ def check(
 ) -> CheckContext:
     """Decide conjunction of Bool terms; optionally lexicographically
     minimize the given BV terms (used by Optimize for tx-sequence
-    minimization, reference analysis/solver.py:222-259)."""
+    minimization, reference analysis/solver.py:222-259).
+
+    Every call counts as one solver query in SolverStatistics — this is
+    the fresh-solve entry every cache/screen layer above bottoms out in,
+    so `query_count`/`solver_time` measure actual solver work (the
+    batched discharge reads the delta to tell a cache hit from a
+    solve)."""
+    from .solver_statistics import SolverStatistics
+
+    ss = SolverStatistics()
+    ss.query_count += 1
+    t_q = time.monotonic()
+    try:
+        return _check_unmeasured(assertions, timeout_s, conflict_budget,
+                                 minimize, maximize, phase_hint)
+    finally:
+        ss.solver_time += time.monotonic() - t_q
+
+
+def _check_unmeasured(
+    assertions: List["T.Term"],
+    timeout_s: float = 10.0,
+    conflict_budget: int = 0,
+    minimize: List["T.Term"] = (),
+    maximize: List["T.Term"] = (),
+    phase_hint=None,
+) -> CheckContext:
     ctx = CheckContext()
     t0 = time.monotonic()
     work = _flatten(assertions)

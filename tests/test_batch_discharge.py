@@ -1,0 +1,190 @@
+"""Batched, shared-prefix incremental feasibility discharge
+(smt/solver/batch.py + support/model.check_batch): verdict parity with
+one-by-one `Constraints.is_possible` — including timeout and
+UNSAT-subset cases — and the prefix-dedup / subset-kill statistics."""
+
+from mythril_tpu.laser.state.constraints import Constraints
+from mythril_tpu.smt import ULE, ULT, symbol_factory
+from mythril_tpu.smt.solver import batch as solver_batch
+from mythril_tpu.smt.solver.core import reset_session
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support.model import check_batch
+
+_N = [0]
+
+
+def _fresh(name):
+    """Per-test-unique symbols: the process-wide term interning and the
+    incremental session must not leak verdicts between tests."""
+    _N[0] += 1
+    return symbol_factory.BitVecSym(f"bd_{name}_{_N[0]}", 256)
+
+
+def _bv(v):
+    return symbol_factory.BitVecVal(v, 256)
+
+
+def _corpus_like_sets():
+    """Fork-sibling shape: a shared prefix plus per-path tails, one
+    contradictory pair, and a strict superset of the contradiction."""
+    x, y = _fresh("x"), _fresh("y")
+    prefix = [ULE(_bv(10), x), ULE(x, _bv(1000))]
+    feasible = Constraints(prefix + [ULE(y, x)])
+    sibling = Constraints(prefix + [ULT(x, y)])
+    unsat_small = Constraints([ULT(x, _bv(5)), ULE(_bv(10), x)])
+    unsat_super = Constraints(list(unsat_small) + [ULE(y, _bv(7))])
+    shared_prefix_only = Constraints(prefix)
+    return [feasible, sibling, unsat_small, unsat_super,
+            shared_prefix_only]
+
+
+def test_check_batch_matches_is_possible():
+    """check_batch verdicts must equal one-by-one is_possible over the
+    same sets, including the UNSAT-subset members."""
+    sets = _corpus_like_sets()
+    expected = [Constraints(list(s)).is_possible() for s in sets]
+    assert check_batch(sets) == expected
+    assert expected == [True, True, False, False, True]
+
+
+def test_check_batch_timeout_semantics():
+    """A query the solver cannot finish inside a short CUSTOM timeout
+    must report possible (True) — is_possible's timeout pessimism —
+    from the batched path too."""
+    x, y = _fresh("tx"), _fresh("ty")
+    # 256-bit factoring-flavored instance: far beyond a 1 ms budget
+    hard = Constraints([
+        x * y == _bv(0xC97B171F7C1D743AA6B837C5FC4BD9F9),
+        ULE(_bv(3), x), ULE(_bv(3), y),
+        ULT(x, _bv(1 << 128)), ULT(y, _bv(1 << 128)),
+    ])
+    easy = Constraints([ULE(_bv(1), x)])
+    got = check_batch([hard, easy], solver_timeout=1)
+    exp = [Constraints(list(s)).is_possible(solver_timeout=1)
+           for s in (hard, easy)]
+    assert got == exp
+    assert got[0] is True  # timeout under a custom budget => possible
+
+
+def test_subset_kill_counted_and_applied():
+    """An UNSAT set must kill its in-batch superset without a solve,
+    and the subset-kill counter must record it."""
+    ss = SolverStatistics()
+    kills0 = ss.subset_kills
+    sets = _corpus_like_sets()
+    verdicts = check_batch(sets)
+    assert verdicts[3] is False  # the superset of the contradiction
+    assert ss.subset_kills > kills0
+
+
+def test_prefix_dedup_statistics_count():
+    """Queries sharing a constraint prefix must register prefix-dedup
+    hits: the incremental session blasts each shared term once and the
+    later queries reuse it."""
+    reset_session()
+    ss = SolverStatistics()
+    hits0, solves0 = ss.prefix_dedup_hits, ss.batch_solve_calls
+    a, b = _fresh("pa"), _fresh("pb")
+    prefix = [ULE(_bv(1), a).raw, ULE(a, _bv(500)).raw]
+    sets = [
+        prefix + [ULE(b, a).raw],
+        prefix + [ULE(b, _bv(7)).raw],
+        prefix + [ULT(a, b).raw],
+    ]
+    verdicts = solver_batch.discharge(sets, timeout_s=10.0)
+    assert verdicts == [solver_batch.SAT] * 3
+    assert ss.batch_solve_calls > solves0
+    # the 2nd and 3rd queries each reuse the 2-term shared prefix
+    assert ss.prefix_dedup_hits >= hits0 + 4
+
+
+def test_sat_subsumption_skips_duplicate_siblings():
+    """A proved-SAT set must answer in-batch duplicates and subsets
+    without reaching get_model (sat_subsumed counts), and
+    batch_solve_calls must count only queries that reached the solver
+    core — so the batched total stays strictly below the one-solve-per-
+    query unbatched path."""
+    reset_session()
+    ss = SolverStatistics()
+    sub0, solves0, q0 = (ss.sat_subsumed, ss.batch_solve_calls,
+                         ss.batch_queries)
+    a, b = _fresh("da"), _fresh("db")
+    prefix = [ULE(_bv(2), a), ULE(a, _bv(300))]
+    full = Constraints(prefix + [ULE(b, a)])
+    dup = Constraints(prefix + [ULE(b, a)])  # same tid-set
+    sub = Constraints(prefix)                # strict subset
+    verdicts = check_batch([full, dup, sub])
+    assert verdicts == [True, True, True]
+    # trie order: sub (shortest) then full discharge; dup's tid-set
+    # equals full's and is answered by the recorded SAT set
+    assert ss.sat_subsumed >= sub0 + 1
+    assert (ss.batch_solve_calls - solves0) < (ss.batch_queries - q0)
+
+
+def test_discharge_subset_registry_propagates_unsat():
+    """Raw-level discharge: a registered UNSAT prefix kills every
+    superset across calls through a shared registry (the lane engine
+    screens successive windows against one registry)."""
+    reset_session()
+    ss = SolverStatistics()
+    kills0 = ss.subset_kills
+    a, b = _fresh("ra"), _fresh("rb")
+    contra = [ULT(a, _bv(4)).raw, ULE(_bv(9), a).raw]
+    registry = solver_batch.SubsetRegistry()
+    first = solver_batch.discharge([contra], registry=registry)
+    assert first == [solver_batch.UNSAT]
+    second = solver_batch.discharge(
+        [contra + [ULE(b, a).raw]], registry=registry)
+    assert second == [solver_batch.UNSAT]
+    assert ss.subset_kills > kills0
+
+
+def test_lane_fork_screen_kills_infeasible_paths(monkeypatch):
+    """End-to-end drain-pipeline screen: a contract branching TWICE on
+    the same calldata bit has two infeasible branch combinations; with
+    fork pruning engaged (args.pruning_factor — the same gate the host
+    pruner uses) the overlapped batch discharge must screen the forked
+    lanes and kill the UNSAT prefixes on device, so only the two
+    feasible paths materialize. Short windows keep the forked lanes
+    RUNNING across window boundaries so the screen has work."""
+    from mythril_tpu.laser.lane_engine import LaneEngine
+    from mythril_tpu.support.support_args import args
+
+    from .harness import asm, push
+    from .test_lane_engine import make_entry
+
+    patches = []
+    code = bytearray()
+
+    def branch_pair():
+        # c = calldata[0] & 1; if ISZERO(c): jump over the marker arm
+        code.extend(push(0, 1) + asm("CALLDATALOAD"))
+        code.extend(push(1, 1) + asm("AND", "ISZERO"))
+        j = len(code)
+        code.extend(push(0, 2) + asm("JUMPI"))
+        code.extend(push(1, 1) + asm("POP"))  # c != 0 arm
+        patches.append((j + 1, len(code)))
+        code.extend(asm("JUMPDEST"))
+
+    branch_pair()
+    for _ in range(10):  # keep lanes running across window boundaries
+        code.extend(push(0, 1) + asm("POP"))
+    branch_pair()
+    for _ in range(10):
+        code.extend(push(0, 1) + asm("POP"))
+    code.extend(asm("STOP"))
+    for off, dest in patches:
+        code[off:off + 2] = dest.to_bytes(2, "big")
+    code = bytes(code)
+
+    monkeypatch.setattr(args, "pruning_factor", 1.0)
+    engine = LaneEngine(n_lanes=32, window=4)
+    parked = engine.explore(code, [make_entry(code, tx_id="bscreen")])
+
+    assert engine.stats["fork_screened"] > 0
+    assert engine.stats["fork_killed"] >= 2
+    # only the (0,0) and (1,1) combinations survive, and each parked
+    # state's constraint prefix is genuinely satisfiable
+    assert len(parked) == 2
+    for gs in parked:
+        assert gs.world_state.constraints.is_possible()

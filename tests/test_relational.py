@@ -8,6 +8,8 @@ CDCL answer agrees)."""
 from mythril_tpu.smt import (
     UGE,
     UGT,
+    ULE,
+    ULT,
     Array,
     symbol_factory,
 )
@@ -167,3 +169,23 @@ def test_fuzz_agreement_with_cdcl():
     # the generator must actually produce refutable shapes, or the
     # agreement check is vacuous
     assert refuted >= 5
+
+
+def test_start_coefficient_merges_when_start_is_an_outflow():
+    """Regression (ADVICE.md high): _discharge_case's expect() must
+    MERGE the start atom's +1 coefficient when the start atom itself is
+    consumed as an outflow — clobbering it (e[tid] = -n) matched a
+    `v <= 0 - start` guard as if it proved `v <= start - start`, and
+    relational_unsat declared this SATISFIABLE system (s=1, v=2, w=1
+    satisfies every conjunct mod 2^256) UNSAT, silently suppressing
+    feasible states downstream of get_model."""
+    s = symbol_factory.BitVecSym("t_sc_s", 256)
+    v = symbol_factory.BitVecSym("t_sc_v", 256)
+    w = symbol_factory.BitVecSym("t_sc_w", 256)
+    system = (
+        ULE(s, (s + v) - v),
+        ULE(v, 0 - s),
+        ULE(w, v),
+        ULT(s, w - v),
+    )
+    assert relational_unsat(system) is False

@@ -258,3 +258,18 @@ def test_bool_equality_interval_not_spuriously_unsat():
     s = Solver()
     s.add(SBool(T.mk_not(T.mk_eq(p, q))))
     assert s.check() == sat
+
+
+def test_annotations_property_materializes_lazy_slot():
+    """Regression (ADVICE.md): `expr.annotations.add(x)` on an
+    annotation-free expression must stick — the lazy None slot used to
+    hand back a throwaway empty set, silently dropping the annotation
+    for any caller mutating the property in place (the documented
+    plugin idiom)."""
+    x = sf.BitVecSym("t_ann_x", 256)
+    assert x.annotations == set()
+    x.annotations.add("tainted")
+    assert "tainted" in x.annotations
+    # the setter and annotate() still interoperate with the property
+    x.annotate("more")
+    assert {"tainted", "more"} <= x.annotations
