@@ -740,8 +740,8 @@ def bench_config4(timeout=60, lanes=4096):
 
 def bench_smoke():
     """`bench.py --smoke`: CI-fast (<60 s on this box) visibility run
-    for the drain pipeline and the batched feasibility discharge — NO
-    full corpus sweep. Two stages:
+    for the drain pipeline, the batched feasibility discharge, and the
+    run-wide verdict cache — NO full corpus sweep. Three stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -749,7 +749,15 @@ def bench_smoke():
        fork screen (fork_screened/fork_killed) exercise for real;
     2. a batched `check_batch` discharge over fork-sibling constraint
        sets (shared prefixes, a contradiction, and its superset), so
-       prefix-dedup and subset-kill provably count.
+       prefix-dedup and subset-kill provably count;
+    3. a SECOND discharge call over descendants of stage 2's sets, so
+       the run-wide verdict cache (smt/solver/verdicts.py) proves
+       cross-call reuse — exact hits, ancestor-UNSAT kills, model
+       shadows — followed by a parity spot-check: a sample of the
+       cached-path verdicts re-derived through plain `is_possible`
+       with the cache disabled. ANY disagreement exits 1 (a cached
+       verdict that diverges from the direct pipeline is a soundness
+       bug, not a perf regression).
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -821,6 +829,50 @@ def bench_smoke():
     verdicts = check_batch(sets)
     out["batch_verdicts"] = {"possible": sum(verdicts),
                              "killed": len(verdicts) - sum(verdicts)}
+
+    # stage 3: run-wide verdict cache (docs/feasibility_cache.md) —
+    # a SECOND discharge call over descendants of stage 2's sets, the
+    # cross-window/cross-call shape the cache exists for: extended
+    # feasible prefixes (model shadows / exact hits) and supersets of
+    # the contradiction (ancestor-UNSAT kills), none seen by THIS
+    # call's in-batch registry
+    from mythril_tpu.smt.solver import verdicts as verdict_mod
+    from mythril_tpu.support import model as support_model
+
+    v0 = dict(ss.batch_counters())
+    children = [Constraints(prefix + [ULE(y, x + BV(j)),
+                                      ULE(y, BV(1 << 20))])
+                for j in range(6)]
+    children += [Constraints(list(contra) + [ULE(x, BV(100 + j))])
+                 for j in range(4)]
+    # exact repeat of a stage 2 set (same tid-set => exact-key hit)
+    children += [Constraints(prefix + [ULE(y, x + BV(0))])]
+    cached = check_batch(children)
+    vd = ss.batch_counters()
+    reuse = {k: round(vd[k] - v0.get(k, 0), 1)
+             for k in ("verdict_hits", "verdict_shadows",
+                       "verdict_shadow_rejects", "verdict_unsat_kills",
+                       "verdict_bound_seeds")}
+    reuse_total = (reuse["verdict_hits"] + reuse["verdict_shadows"]
+                   + reuse["verdict_unsat_kills"])
+
+    # parity spot-check: re-derive a sample of the cached-path verdicts
+    # through the plain is_possible pipeline with the cache OFF and the
+    # get_model memo cleared — zero tolerance for disagreement
+    sample = list(range(0, len(children), 2))
+    verdict_mod.ENABLED = False
+    support_model.get_model.cache_clear()
+    try:
+        direct = [Constraints(list(children[i])).is_possible()
+                  for i in sample]
+    finally:
+        verdict_mod.ENABLED = True
+    mismatches = sum(1 for i, d in zip(sample, direct)
+                     if cached[i] != d)
+    out["verdict_cache"] = dict(
+        reuse, reuse_total=reuse_total,
+        spot_check={"sampled": len(sample), "mismatches": mismatches})
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -828,7 +880,12 @@ def bench_smoke():
     print(json.dumps(out), flush=True)
     ok = (out["solver_batch"]["subset_kills"] > 0
           and out["solver_batch"]["batch_solve_calls"]
-          < out["solver_batch"]["batch_queries"])
+          < out["solver_batch"]["batch_queries"]
+          # run-wide verdict cache must show cross-call reuse, and a
+          # cached verdict disagreeing with direct is_possible is an
+          # instant failure (soundness, not perf)
+          and reuse_total > 0
+          and mismatches == 0)
     return 0 if ok else 1
 
 

@@ -1206,6 +1206,12 @@ _ARITY.update({"EQ": 2, "EXP": 2, "ISZERO": 1, "NOT": 1,
 DEFAULT_WINDOW = 256
 DEFAULT_STEP_BUDGET = 8192
 
+#: in-explore safety caps for the engine's id-keyed memos (they also
+#: clear wholesale at every explore — persistent corpus engines
+#: otherwise grow them without bound; see _reset_explore_memos)
+_CDL_CACHE_CAP = 1 << 16
+_RECORD_MEMO_CAP = 1 << 20
+
 
 #: minimum tunneled wave size for device engagement: below this the
 #: fixed per-wave dispatch+pull round trip (~0.1-0.13 s on a tunneled
@@ -1369,10 +1375,15 @@ class LaneEngine:
         self._func_names: Dict[int, str] = {}
         # repeated CALLDATALOADs at the same offset across lanes resolve
         # to the same word term; building it once matters (32 If+select
-        # terms per word)
-        self._cdl_cache: Dict[Tuple[int, int], BitVec] = {}
+        # terms per word). All three memos below key on id()s and
+        # per-window (step, pc) tuples, which alias across codes once
+        # the owning objects die — they reset at every explore() (see
+        # _reset_explore_memos) and values pin the id-keyed owners so
+        # an id cannot be recycled while its entry is live.
+        self._cdl_cache: Dict[Tuple[int, int], tuple] = {}
         self._record_memo: Dict[tuple, int] = {}
         self._fired_sites: set = set()
+        self._memo_pins: list = []
         self.stats = {
             "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
             "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
@@ -1689,10 +1700,16 @@ class LaneEngine:
         if opname == "CALLDATALOAD":
             off = alu.to_bitvec(args[0])
             key = (id(ctx.calldata), off.raw.tid)
-            cached = self._cdl_cache.get(key)
-            if cached is None:
-                cached = ctx.calldata.get_word_at(off)
-                self._cdl_cache[key] = cached
+            hit = self._cdl_cache.get(key)
+            if hit is not None:
+                return hit[1]
+            cached = ctx.calldata.get_word_at(off)
+            if len(self._cdl_cache) > _CDL_CACHE_CAP:
+                self._cdl_cache.clear()
+            # the value pins the calldata object: its id (the key) can
+            # never be recycled onto a different calldata while the
+            # entry is live
+            self._cdl_cache[key] = (ctx.calldata, cached)
             return cached
         if opname == "SLOAD":
             return _storage_read_term(ctx.storage_seed_raw,
@@ -1857,9 +1874,12 @@ class LaneEngine:
                         key_parts.append(
                             ("o", prov[(idx // d_recs,
                                         idx % d_recs)]))
-                # SLOAD/CALLDATALOAD resolve against per-seed context
+                # SLOAD/CALLDATALOAD resolve against per-seed context;
+                # pin the template so its id (part of the key) cannot
+                # be recycled while the memo entry is live
                 if opname in ("SLOAD", "CALLDATALOAD", "BALANCE"):
                     key_parts.append(("ctx", id(ctx.template)))
+                    self._memo_pins.append(ctx.template)
                 # annotated arithmetic is per-site AND per-seed: two
                 # executions at different pcs (or from different entry
                 # states) must annotate separately — the interpreter
@@ -1867,6 +1887,7 @@ class LaneEngine:
                 if opname in self._annot_ops:
                     key_parts.append(("pc", pc, "ctx",
                                       id(ctx.template)))
+                    self._memo_pins.append(ctx.template)
                 key = tuple(key_parts)
                 oid = self._record_memo.get(key)
                 if oid is None:
@@ -1891,6 +1912,8 @@ class LaneEngine:
                     elif isinstance(obj, int):
                         obj = _bv_val(obj)
                     oid = self.objects.add(obj)
+                    if len(self._record_memo) > _RECORD_MEMO_CAP:
+                        self._record_memo.clear()
                     self._record_memo[key] = oid
                 prov[(lane, slot)] = oid
             else:
@@ -2228,6 +2251,20 @@ class LaneEngine:
         self.stats["parked"] += 1
         return gs
 
+    # -- per-explore memo hygiene --------------------------------------------
+
+    def _reset_explore_memos(self) -> None:
+        """Clear the id-/site-keyed memos at every explore. Persistent
+        engines (corpus runs) otherwise grow them without bound, and
+        their keys — object ids, (step, pc) tuples — alias across
+        codes once the owning objects die. Within one explore the
+        memo values/pins keep the id-keyed owners alive, so id reuse
+        cannot corrupt a live entry."""
+        self._cdl_cache.clear()
+        self._record_memo.clear()
+        self._fired_sites.clear()
+        self._memo_pins.clear()
+
     # -- overlapped fork-feasibility screening -------------------------------
 
     def _screen_forks(self, queries, registry):
@@ -2242,7 +2279,12 @@ class LaneEngine:
         under the same args.pruning_factor gate — the default-off host
         policy keeps lane/host path counts identical by default).
         Screening a lane's conds WITHOUT the keccak axioms is sound
-        for killing: an UNSAT subset implies an UNSAT superset."""
+        for killing: an UNSAT subset implies an UNSAT superset. The
+        discharge also consults the RUN-WIDE verdict cache
+        (smt/solver/verdicts.py): a prefix refuted in any earlier
+        window or call site kills its descendants here without a
+        solve, and prefixes this screen refutes kill the open-state
+        screen's supersets later."""
         from ..smt import Model
         from ..smt.solver import batch as solver_batch
         from ..support.model import model_cache
@@ -2285,6 +2327,7 @@ class LaneEngine:
                     "address_to_function_name", {}) or {}
         ) if entry_states else {}
         stats0 = dict(self.stats)  # engines persist across explores
+        self._reset_explore_memos()
         cc = _compiled_code(code_bytes, self._func_names.keys())
         if self._rep_sh is not None:
             # SPMD mode: code tensors (and the op tables) replicate

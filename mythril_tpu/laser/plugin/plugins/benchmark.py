@@ -84,7 +84,21 @@ class BenchmarkPlugin(LaserPlugin):
                 SolverStatistics,
             )
 
-            log.info("Solver batch/pipeline: %s",
-                     SolverStatistics().batch_counters())
+            counters = SolverStatistics().batch_counters()
+            log.info("Solver batch/pipeline: %s", counters)
+            # run-wide verdict cache (docs/feasibility_cache.md): the
+            # three reuse tiers, one line — exact hits, ancestor-UNSAT
+            # kills, parent-model shadows — plus the combined
+            # queries_saved figure bench.py gates on
+            log.info(
+                "Verdict cache: hits=%d unsat_kills=%d shadows=%d "
+                "shadow_rejects=%d bound_seeds=%d queries_saved=%d",
+                counters["verdict_hits"],
+                counters["verdict_unsat_kills"],
+                counters["verdict_shadows"],
+                counters["verdict_shadow_rejects"],
+                counters["verdict_bound_seeds"],
+                counters["queries_saved"],
+            )
         except Exception:  # telemetry only, never an error path
             pass

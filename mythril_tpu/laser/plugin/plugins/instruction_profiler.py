@@ -75,8 +75,21 @@ class InstructionProfiler(LaserPlugin):
                 SolverStatistics,
             )
 
-            lines.append("Solver batch/pipeline: {}".format(
-                SolverStatistics().batch_counters()))
+            counters = SolverStatistics().batch_counters()
+            lines.append("Solver batch/pipeline: {}".format(counters))
+            # run-wide verdict cache reuse tiers
+            # (docs/feasibility_cache.md)
+            lines.append(
+                "Verdict cache: hits={} unsat_kills={} shadows={} "
+                "shadow_rejects={} bound_seeds={} "
+                "queries_saved={}".format(
+                    counters["verdict_hits"],
+                    counters["verdict_unsat_kills"],
+                    counters["verdict_shadows"],
+                    counters["verdict_shadow_rejects"],
+                    counters["verdict_bound_seeds"],
+                    counters["queries_saved"],
+                ))
         except Exception:  # telemetry only
             pass
         for r in sorted(

@@ -287,20 +287,20 @@ class LaserEVM:
                 open_states = prefilter_world_states(open_states)
             except Exception as e:  # never let the fast path break the run
                 log.debug("TPU prefilter unavailable: %s", e)
-        if len(open_states) > 1:
+        if open_states:
             # batched discharge: sibling open states share long
             # constraint prefixes (they forked from common JUMPIs), so
             # one trie-ordered pass over the incremental session
             # replaces per-state from-scratch solves; verdict semantics
-            # are identical to is_possible (support/model.check_batch)
+            # are identical to is_possible (support/model.check_batch).
+            # Single-state rounds route through the same seam so the
+            # run-wide verdict cache (smt/solver/verdicts.py) answers
+            # prefixes already proved in earlier rounds and windows.
             from ..support.model import check_batch
 
             keep = check_batch([s.constraints for s in open_states])
             return [s for s, ok in zip(open_states, keep) if ok]
-        return [
-            state for state in open_states
-            if state.constraints.is_possible()
-        ]
+        return open_states
 
     # -- timeouts -----------------------------------------------------------
 

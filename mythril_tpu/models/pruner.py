@@ -25,6 +25,25 @@ def _all_constraints(constraints):
     getter = getattr(constraints, "get_all_constraints", None)
     return getter() if getter is not None else list(constraints)
 
+
+def _interval_infeasible(constraints) -> bool:
+    """Host interval screen routed through the run-wide verdict cache
+    (smt/solver/verdicts.py): the screen seeds from the longest cached
+    prefix's variable bounds (tier 3) and records refutations so
+    descendant sets across windows and call sites die by ancestor
+    subsumption. Falls back to plain state_infeasible when the cache is
+    disabled."""
+    raws = [getattr(c, "raw", c) for c in constraints]
+    try:
+        from ..smt.solver import verdicts
+
+        vc = verdicts.cache()
+        if vc is not None:
+            return vc.interval_unsat(raws)
+    except Exception:
+        pass
+    return state_infeasible(raws)
+
 # below this many states the host loop beats device dispatch overhead
 DEVICE_BATCH_THRESHOLD = 8
 # over a tunneled link every dispatch pays network latency AND the
@@ -102,7 +121,7 @@ def prefilter_world_states(open_states: List) -> List:
     dropped = 0
     for ws in open_states:
         try:
-            infeasible = state_infeasible(
+            infeasible = _interval_infeasible(
                 list(_all_constraints(ws.constraints)))
         except Exception as e:
             log.debug("interval screening failed: %s", e)
@@ -148,7 +167,7 @@ def _screen_interval(items: List, get_constraints) -> List:
         out = []
         for it in items:
             try:
-                if state_infeasible(list(get_constraints(it))):
+                if _interval_infeasible(list(get_constraints(it))):
                     continue
             except Exception:
                 pass
@@ -175,14 +194,16 @@ def prune_feasible_states(states: List) -> List:
         lambda s: _all_constraints(s.world_state.constraints))
     from ..laser.state.constraints import Constraints
 
-    if len(survivors) > 1 and all(
+    if survivors and all(
         isinstance(s.world_state.constraints, Constraints)
         for s in survivors
     ):
         # fork siblings share their constraint prefix by construction:
         # the batched discharge asserts it once and subset-kills
         # UNSAT supersets (support/model.check_batch; is_possible
-        # semantics preserved, including timeout-means-possible)
+        # semantics preserved, including timeout-means-possible).
+        # Single survivors route through the same seam so the run-wide
+        # verdict cache answers already-proved prefixes.
         from ..support.model import check_batch
 
         keep = check_batch(

@@ -35,7 +35,8 @@ Encoding details:
 """
 
 import logging
-from typing import Dict, List, Sequence
+import os
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +68,43 @@ _BINOP_MAP = {
     T.LSHR: LSHR,
 }
 
+# ---------------------------------------------------------------------------
+# compile-key canonicalization
+# ---------------------------------------------------------------------------
+#
+# The level kernel jit-specializes per (ops_present, shapes). Raw keys
+# made every structurally-new DAG a cold compile: level widths repeat
+# (pow2-padded) but the node-table row count and the exact opcode subset
+# of each level varied per contract, so a corpus sweep re-specialized
+# near-identical kernels dozens of times (a tunneled wave measured 50 s
+# in one compile — see models/pruner.py). Two canonicalizations collapse
+# the key space:
+#
+# 1. the node table pads to a power of two, so table shapes bucket the
+#    same way level widths and the state axis already do;
+# 2. a level's ops_present widens to the CHEAP cover (every transfer
+#    function except the 512-bit MUL product and the UDIV/UREM
+#    shift-subtract loops) plus exactly the expensive ops it uses.
+#    Absent ops are masked off by the per-node opcode select, so the
+#    result is bit-identical; the cheap extras cost a few masked
+#    elementwise bv256 ops at runtime while structurally-repeated DAGs
+#    across contracts hit the jit cache instead of recompiling.
+#
+# MYTHRIL_TPU_INTERVAL_CANONICAL=0 restores exact keys (A/B debugging).
+
+CANONICAL_KEYS = os.environ.get(
+    "MYTHRIL_TPU_INTERVAL_CANONICAL", "1") != "0"
+
+_EXPENSIVE_OPS = frozenset({MUL, UDIV, UREM})
+_CHEAP_COVER = frozenset(range(1, 26)) - _EXPENSIVE_OPS
+
+
+def _canonical_ops(ops: set) -> tuple:
+    """Static compile key for a level's opcode set (see above)."""
+    if not CANONICAL_KEYS:
+        return tuple(sorted(ops))
+    return tuple(sorted(_CHEAP_COVER | (ops & _EXPENSIVE_OPS)))
+
 
 class EncodedDAG:
     """Host-side linearization of a term-DAG union into level tensors."""
@@ -93,12 +131,23 @@ def _next_pow2(x: int) -> int:
     return 1 << max(x - 1, 0).bit_length()
 
 
-def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
+def linearize(assertion_sets: Sequence[Sequence["T.Term"]],
+              pin_bv: Optional[Dict[str, int]] = None,
+              pin_bools: Optional[Dict[str, bool]] = None) -> EncodedDAG:
     """Topo-sort the union DAG, bake static node tensors, and extract the
-    per-state variable-bound seeds."""
+    per-state variable-bound seeds.
+
+    ``pin_bv``/``pin_bools`` pin named variables to point intervals —
+    the model-shadow evaluation mode (smt/solver/verdicts.py): every
+    state shares one assignment, so the pins bake into the shared init
+    tables, the per-state bound seeds are skipped, and a must-true
+    assertion under the pins is exact (sound for proving SAT)."""
     assertion_sets = [
         [getattr(t, "raw", t) for t in s] for s in assertion_sets
     ]
+    pinned = pin_bv is not None or pin_bools is not None
+    pin_bv = pin_bv or {}
+    pin_bools = pin_bools or {}
     # collect nodes iteratively (deep chains exceed recursion limits)
     depth: Dict[int, int] = {}
     nodes: Dict[int, "T.Term"] = {}
@@ -121,8 +170,15 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
     index = {t.tid: i for i, t in enumerate(order)}
     n = len(order)
 
-    init_lo = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
-    init_hi = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
+    # table rows bucket to a power of two (the pad slot at index n and
+    # above is never an argument of a real node, so writes landing
+    # there are inert) — repeated DAG sizes across contracts then share
+    # the level kernels' (S, T, 8) table shapes instead of
+    # re-specializing per exact node count
+    n_slots = _next_pow2(n + 1) if CANONICAL_KEYS else n
+
+    init_lo = np.zeros((n_slots, bv256.NLIMBS), dtype=np.uint32)
+    init_hi = np.zeros((n_slots, bv256.NLIMBS), dtype=np.uint32)
     dev_op = np.zeros(n, dtype=np.int32)
     args = np.zeros((n, 3), dtype=np.int32)
     mask_w = np.zeros((n, bv256.NLIMBS), dtype=np.uint32)
@@ -149,9 +205,18 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
             init_hi[i] = _word(1)  # (may_false=0, may_true=1)
         elif op == T.FALSE:
             init_lo[i] = _word(1)
+        elif op == T.BOOL_VAR and t.name in pin_bools:
+            # pinned definite bool: (may_false, may_true) = (!v, v)
+            val = bool(pin_bools[t.name])
+            init_lo[i] = _word(0 if val else 1)
+            init_hi[i] = _word(1 if val else 0)
         elif t.is_bool:
             init_lo[i] = _word(1)
             init_hi[i] = _word(1)
+        elif op == T.BV_VAR and not wide and w and t.name in pin_bv:
+            # pinned point interval from the shadow model
+            val = int(pin_bv[t.name]) & ((1 << w) - 1)
+            init_lo[i] = init_hi[i] = _word(val)
         elif w:
             init_hi[i] = _word((1 << min(w, 256)) - 1)
 
@@ -213,11 +278,12 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
         # NOP at its seeded default
 
     # build level tensors (skip levels that are all NOP — usually leaves).
-    # Width is padded to a power of two and each level records the set of
-    # opcodes it contains: the level kernel is jit-specialized per
-    # (ops_present, shapes) so tiny DAGs don't pay for the 512-bit MUL or
-    # the divmod shift-subtract loops unless those ops actually occur, and
-    # repeat shapes hit the jit cache.
+    # Width is padded to a power of two and each level records a
+    # CANONICALIZED opcode set (_canonical_ops): the level kernel is
+    # jit-specialized per (ops_present, shapes), the cheap-cover key
+    # keeps expensive ops (512-bit MUL, divmod shift-subtract) gated on
+    # actual occurrence, and structurally-repeated DAGs across contracts
+    # hit the jit cache instead of paying a per-shape cold compile.
     levels = []
     start = 0
     while start < n:
@@ -249,15 +315,20 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]]) -> EncodedDAG:
                     args=jnp.asarray(args_p),
                     mask=jnp.asarray(mask_p),
                     aux=jnp.asarray(aux_p),
-                    ops_present=tuple(
-                        sorted(set(dev_op[idx].tolist()) - {NOP})),
+                    ops_present=_canonical_ops(
+                        set(dev_op[idx].tolist()) - {NOP}),
                 )
             )
         start = end
 
-    # per-state variable-bound seeds + assertion pointers
+    # per-state variable-bound seeds + assertion pointers (pinned mode
+    # bakes the one shared assignment into the init tables above; the
+    # syntactic bound seeds add nothing to point intervals and their
+    # empty-range dead marking would conflate "model rejected" with
+    # "infeasible", so they are skipped)
     n_states = len(assertion_sets)
-    all_bounds = [extract_bounds(s) for s in assertion_sets]
+    all_bounds = ([{} for _ in assertion_sets] if pinned
+                  else [extract_bounds(s) for s in assertion_sets])
     max_v = max((len(b) for b in all_bounds), default=1) or 1
     seed_idx = np.full((n_states, max_v), n, dtype=np.int32)
     seed_lo = np.zeros((n_states, max_v, bv256.NLIMBS), dtype=np.uint32)
@@ -513,8 +584,10 @@ def _eval_level(level, lo_tab, hi_tab, ops_present):
 _eval_level_jit = jax.jit(_eval_level, static_argnames=("ops_present",))
 
 
-def eval_feasible(enc: EncodedDAG) -> np.ndarray:
-    """Returns (n_states,) bool: True = state may be feasible (keep)."""
+def _run_tables(enc: EncodedDAG):
+    """Seed the per-state interval tables, sweep every level, and
+    return (lo_tab, hi_tab, rows, assert_idx, assert_mask, n_states) —
+    the shared core of the feasibility and shadow evaluations."""
     n_states = enc.assert_idx.shape[0]
     n = enc.n_nodes
     # pad the state axis to a power of two so repeated batch sizes reuse
@@ -553,9 +626,38 @@ def eval_feasible(enc: EncodedDAG) -> np.ndarray:
         lo_tab, hi_tab = _eval_level_jit(
             arrays, lo_tab, hi_tab, ops_present=level["ops_present"]
         )
+    return lo_tab, hi_tab, rows, assert_idx, assert_mask, n_states
+
+
+def eval_feasible(enc: EncodedDAG) -> np.ndarray:
+    """Returns (n_states,) bool: True = state may be feasible (keep)."""
+    lo_tab, hi_tab, rows, assert_idx, assert_mask, n_states = (
+        _run_tables(enc))
     may_true = hi_tab[rows, jnp.asarray(assert_idx)][..., 0] != 0  # (S, A)
     ok = np.asarray(jnp.all(may_true | ~jnp.asarray(assert_mask), axis=1))
     return ok[:n_states] & ~enc.dead
+
+
+def eval_shadow(enc: EncodedDAG):
+    """(proved, rejected) bool arrays for a model-pinned encoding.
+
+    proved: every live assertion is MUST-true (may_false bit 0) — with
+    the shadow model pinned as point intervals, every completion of the
+    pinned assignment satisfies the set, so the parent model extends to
+    a witness (sound SAT proof). rejected: some live assertion is
+    MUST-false — every completion falsifies it, so the shadow model
+    cannot survive (says nothing about satisfiability by other models).
+    Neither flag set = the abstraction lost precision; the caller
+    decides by exact host term-eval."""
+    lo_tab, hi_tab, rows, assert_idx, assert_mask, n_states = (
+        _run_tables(enc))
+    aidx = jnp.asarray(assert_idx)
+    amask = jnp.asarray(assert_mask)
+    may_false = lo_tab[rows, aidx][..., 0] != 0  # (S, A)
+    may_true = hi_tab[rows, aidx][..., 0] != 0
+    proved = np.asarray(jnp.all(~may_false | ~amask, axis=1))
+    rejected = np.asarray(jnp.any(~may_true & amask, axis=1))
+    return proved[:n_states], rejected[:n_states]
 
 
 def prefilter_feasible(assertion_sets) -> np.ndarray:
@@ -563,3 +665,13 @@ def prefilter_feasible(assertion_sets) -> np.ndarray:
     states report False."""
     enc = linearize(assertion_sets)
     return eval_feasible(enc)
+
+
+def shadow_prefilter(delta_sets, bv_values: Dict[str, int],
+                     bool_values: Dict[str, bool]):
+    """Device-batched model shadowing (tier 2 of the run-wide verdict
+    cache, smt/solver/verdicts.py): evaluate each delta constraint set
+    under one parent model pinned as point intervals. Returns
+    (proved, rejected) per set — see eval_shadow for the semantics."""
+    enc = linearize(delta_sets, pin_bv=bv_values, pin_bools=bool_values)
+    return eval_shadow(enc)

@@ -22,6 +22,12 @@ into one pass over the shared incremental session (core._IncrementalSession):
    ModelCache, it quick-sat-serves sibling queries before any fresh
    solve (`quick_sat`).
 
+Since PR 2 every query also consults the RUN-WIDE verdict cache
+(verdicts.py): exact-key reuse, ancestor-UNSAT subsumption and
+parent-model shadowing answer repeats across windows and call sites
+before the in-batch screens even matter, and every core SAT/UNSAT
+proof found here is recorded back for the rest of the run.
+
 Verdicts are exactly the core's (SAT/UNSAT/UNKNOWN); soundness is
 inherited — subset-kill only ever strengthens a proved-UNSAT set.
 Counters land in SolverStatistics (solver_statistics.py) and surface
@@ -33,6 +39,7 @@ from typing import Callable, List, Optional, Sequence
 
 from .. import terms as T
 from . import core
+from . import verdicts as verdict_mod
 from .solver_statistics import SolverStatistics
 
 SAT, UNSAT, UNKNOWN = core.SAT, core.UNSAT, core.UNKNOWN
@@ -155,6 +162,25 @@ def discharge(
             ss.sat_subsumed += 1
             verdicts[i] = SAT
             continue
+        # run-wide verdict cache (verdicts.py): exact-key reuse,
+        # ancestor-UNSAT subsumption across windows AND call sites,
+        # parent-model shadowing — all before any solver work
+        vc = verdict_mod.cache()
+        if vc is not None:
+            v, model = vc.probe(work)
+            if v == UNSAT:
+                registry.note_unsat(tids)
+                verdicts[i] = UNSAT
+                continue
+            if v == SAT:
+                registry.note_sat(tids)
+                verdicts[i] = SAT
+                if on_sat_model is not None and model is not None:
+                    try:
+                        on_sat_model(model)
+                    except Exception:
+                        pass
+                continue
         if quick_sat is not None:
             try:
                 if quick_sat(T.mk_bool_and(*work)):
@@ -176,8 +202,12 @@ def discharge(
         verdicts[i] = ctx.status
         if ctx.status == UNSAT:
             registry.note_unsat(tids)
+            if vc is not None:  # a core refutation is a run-wide proof
+                vc.record(tid_key(work), UNSAT)
         elif ctx.status == SAT:
             registry.note_sat(tids)
+            if vc is not None:
+                vc.record(tid_key(work), SAT, model=ctx.model)
             if on_sat_model is not None and ctx.model is not None:
                 try:
                     on_sat_model(ctx.model)

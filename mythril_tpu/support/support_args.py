@@ -26,6 +26,10 @@ class Args(object, metaclass=Singleton):
         self.disable_mutation_pruner = False
         self.incremental_txs = True
         self.epic = False
+        # get_model memo entries (support/model.py; MYTHRIL_TPU_MODEL_LRU
+        # env overrides, 0 disables). The seed's 2**23 was an OOM risk
+        # on corpus runs — every entry pins a Model and its eval memos.
+        self.model_lru_size = 2 ** 14
         self.pruning_factor: Optional[float] = None
         # TPU lane-engine knobs (new in this build)
         # -1 = auto (batched lanes on a local accelerator, host-only

@@ -1,0 +1,235 @@
+"""Run-wide feasibility verdict cache (smt/solver/verdicts.py):
+is_possible parity over a randomized constraint-tree corpus,
+ancestor-UNSAT subsumption across separate discharge calls,
+model-shadow accept/reject, and fingerprint stability under
+constraint reordering (the soundness requirement: the cache key must
+be canonical in constraint order — docs/feasibility_cache.md)."""
+
+import random
+
+import pytest
+
+from mythril_tpu.laser.state.constraints import Constraints
+from mythril_tpu.smt import ULE, ULT, symbol_factory
+from mythril_tpu.smt.solver import batch as solver_batch
+from mythril_tpu.smt.solver import verdicts
+from mythril_tpu.smt.solver.core import reset_session
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support import model as support_model
+from mythril_tpu.support.model import check_batch
+
+_N = [0]
+
+
+def _fresh(name):
+    """Per-test-unique symbols: terms are interned process-wide, so
+    reused names would leak verdicts between tests."""
+    _N[0] += 1
+    return symbol_factory.BitVecSym(f"vcache_{name}_{_N[0]}", 256)
+
+
+def _bv(v):
+    return symbol_factory.BitVecVal(v, 256)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Each test starts from an empty run-wide cache (and leaves the
+    module enabled for the rest of the process)."""
+    verdicts.reset_cache()
+    verdicts.ENABLED = True
+    yield
+    verdicts.reset_cache()
+    verdicts.ENABLED = True
+
+
+def _random_tree_sets(rng, symbols, depth=4, fanout=2):
+    """Randomized constraint-tree corpus: each node extends its parent's
+    constraint list with one random comparison (the monotone path-growth
+    shape the fingerprints exploit); some branches are contradictory."""
+    sets = []
+
+    def grow(prefix, d):
+        sets.append(list(prefix))
+        if d == 0:
+            return
+        for _ in range(fanout):
+            s = rng.choice(symbols)
+            bound = rng.randrange(1, 1 << 16)
+            kind = rng.randrange(3)
+            if kind == 0:
+                c = ULE(s, _bv(bound))
+            elif kind == 1:
+                c = ULE(_bv(bound), s)
+            else:
+                c = ULT(s, _bv(bound))
+            grow(prefix + [c], d - 1)
+
+    root = [ULE(_bv(1), symbols[0]), ULE(symbols[0], _bv(1 << 20))]
+    grow(root, depth)
+    return sets
+
+
+def test_parity_on_randomized_constraint_tree():
+    """check_batch WITH the run-wide cache must agree with direct
+    one-by-one is_possible WITHOUT it over a randomized tree corpus —
+    and the tree shape must actually produce cache reuse."""
+    rng = random.Random(0xC0FFEE)
+    symbols = [_fresh("t") for _ in range(3)]
+    sets = _random_tree_sets(rng, symbols)
+    ss = SolverStatistics()
+    reuse0 = (ss.verdict_hits + ss.verdict_shadows
+              + ss.verdict_unsat_kills)
+
+    got = check_batch([Constraints(s) for s in sets])
+
+    reuse = (ss.verdict_hits + ss.verdict_shadows
+             + ss.verdict_unsat_kills) - reuse0
+    assert reuse > 0  # parent prefixes answered descendants
+
+    # reference pass: cache OFF and the get_model memo cleared, so
+    # every verdict re-derives through the plain is_possible pipeline
+    verdicts.ENABLED = False
+    support_model.get_model.cache_clear()
+    try:
+        expected = [Constraints(s).is_possible() for s in sets]
+    finally:
+        verdicts.ENABLED = True
+    assert got == expected
+
+
+def test_ancestor_unsat_subsumes_across_discharge_calls():
+    """An UNSAT set proved in one discharge call must kill its
+    supersets in a LATER call with a fresh registry — the run-wide
+    extension of the in-batch subset-kill — without new solver work."""
+    reset_session()
+    ss = SolverStatistics()
+    a, b = _fresh("aa"), _fresh("ab")
+    contra = [ULT(a, _bv(4)).raw, ULE(_bv(9), a).raw]
+
+    first = solver_batch.discharge([contra])
+    assert first == [solver_batch.UNSAT]
+
+    kills0, solves0 = ss.verdict_unsat_kills, ss.batch_solve_calls
+    second = solver_batch.discharge(
+        [contra + [ULE(b, a).raw], contra + [ULE(b, _bv(7)).raw]])
+    assert second == [solver_batch.UNSAT, solver_batch.UNSAT]
+    assert ss.verdict_unsat_kills > kills0
+    assert ss.batch_solve_calls == solves0  # zero new solves
+
+
+def test_model_shadow_proves_child_sat():
+    """A parent's cached model that satisfies the delta constraints
+    proves the child SAT with zero solver work."""
+    reset_session()
+    ss = SolverStatistics()
+    x = _fresh("sx")
+    parent = [ULE(_bv(10), x).raw, ULE(x, _bv(1000)).raw]
+    assert solver_batch.discharge([parent]) == [solver_batch.SAT]
+
+    shadows0, solves0 = ss.verdict_shadows, ss.batch_solve_calls
+    child = parent + [ULE(x, _bv(2000)).raw]  # true under any parent model
+    assert solver_batch.discharge([child]) == [solver_batch.SAT]
+    assert ss.verdict_shadows > shadows0
+    assert ss.batch_solve_calls == solves0
+
+
+def test_model_shadow_rejected_by_invalidating_delta():
+    """A delta constraint the parent model falsifies must REJECT the
+    shadow (counted), and the child's verdict must still be correct —
+    SAT here, via a real solve, since the set is satisfiable by OTHER
+    models."""
+    reset_session()
+    ss = SolverStatistics()
+    x = _fresh("rx")
+    parent = [ULE(_bv(10), x).raw, ULE(x, _bv(1000)).raw]
+    assert solver_batch.discharge([parent]) == [solver_batch.SAT]
+    vc = verdicts.cache()
+    md = vc._entries[vc.key(tuple(t.tid for t in parent))].model
+    model_x = md.bv[x.raw.name]
+
+    # a delta that excludes exactly the cached model's value but keeps
+    # the set satisfiable
+    if model_x < 1000:
+        delta = ULE(_bv(model_x + 1), x)  # forces x > model value
+    else:
+        delta = ULT(x, _bv(model_x))      # forces x < model value
+    child = parent + [delta.raw]
+    rejects0, shadows0 = ss.verdict_shadow_rejects, ss.verdict_shadows
+    got = solver_batch.discharge([child])
+    assert got == [solver_batch.SAT]
+    assert ss.verdict_shadow_rejects > rejects0
+    assert ss.verdict_shadows == shadows0  # the shadow did NOT prove it
+
+
+def test_fingerprint_stable_under_reordering():
+    """Two orderings (and duplications) of the same conjunction must
+    produce the SAME canonical key, so a verdict proved under one order
+    answers the other exactly."""
+    vc = verdicts.cache()
+    a, b = _fresh("fa"), _fresh("fb")
+    c1, c2, c3 = (ULE(_bv(5), a).raw, ULE(a, _bv(900)).raw,
+                  ULE(b, a).raw)
+    fwd = (c1.tid, c2.tid, c3.tid)
+    rev = (c3.tid, c1.tid, c2.tid)
+    dup = (c1.tid, c2.tid, c3.tid, c1.tid)
+    assert vc.key(fwd) is vc.key(rev)
+    assert vc.key(fwd) is vc.key(dup)
+
+    reset_session()
+    ss = SolverStatistics()
+    assert solver_batch.discharge([[c1, c2, c3]]) == [solver_batch.SAT]
+    hits0, solves0 = ss.verdict_hits, ss.batch_solve_calls
+    assert solver_batch.discharge([[c3, c1, c2]]) == [solver_batch.SAT]
+    assert ss.verdict_hits > hits0          # exact-key hit
+    assert ss.batch_solve_calls == solves0  # no re-solve
+
+
+def test_unsat_fingerprint_reorder_kills_exactly():
+    """Reordered UNSAT sets hit the same entry; a PROPER SUBSET of an
+    UNSAT set must NOT be answered by it (subsumption only kills
+    supersets)."""
+    reset_session()
+    a, b = _fresh("ua"), _fresh("ub")
+    c_lo, c_hi = ULT(a, _bv(4)).raw, ULE(_bv(9), a).raw
+    extra = ULE(b, _bv(7)).raw
+    assert solver_batch.discharge([[c_lo, c_hi]]) == [solver_batch.UNSAT]
+    ss = SolverStatistics()
+    hits0 = ss.verdict_hits
+    assert solver_batch.discharge([[c_hi, c_lo]]) == [solver_batch.UNSAT]
+    assert ss.verdict_hits > hits0
+    # the satisfiable subset {c_lo} must stay SAT
+    assert solver_batch.discharge([[c_lo]]) == [solver_batch.SAT]
+    # and a superset still dies across calls
+    assert solver_batch.discharge(
+        [[extra, c_hi, c_lo]]) == [solver_batch.UNSAT]
+
+
+def test_timeout_verdicts_never_cached():
+    """UNKNOWN (timeout) verdicts must not enter the cache: a later
+    query on the same set must not be answered from a non-proof."""
+    vc = verdicts.cache()
+    x = _fresh("to")
+    t = ULE(_bv(1), x).raw
+    vc.record((t.tid,), verdicts.UNKNOWN)
+    v, _ = vc.probe([t])
+    assert v is None
+
+
+def test_interval_bound_inheritance_parity():
+    """Tier 3: a child's interval screen seeded from the parent's
+    cached bounds must agree with the from-scratch screen, and the
+    seed counter must record the inheritance."""
+    from mythril_tpu.smt.interval import state_infeasible
+
+    vc = verdicts.cache()
+    ss = SolverStatistics()
+    x = _fresh("bx")
+    pre = [ULE(_bv(100), x).raw, ULE(x, _bv(1000)).raw]
+    assert vc.interval_unsat(pre) is state_infeasible(pre) is False
+    seeds0 = ss.verdict_bound_seeds
+    bad = pre + [ULT(x, _bv(50)).raw]
+    ok = pre + [ULE(x, _bv(500)).raw]
+    assert vc.interval_unsat(bad) is state_infeasible(bad) is True
+    assert vc.interval_unsat(ok) is state_infeasible(ok) is False
+    assert ss.verdict_bound_seeds > seeds0
