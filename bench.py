@@ -738,10 +738,124 @@ def bench_config4(timeout=60, lanes=4096):
     }
 
 
+def _smoke_steal():
+    """Stage 4: two-rank local steal gate (docs/work_stealing.md).
+
+    A rigged long-pole corpus on the CPU backend — one heavy contract
+    (per-path MTPU_PATH_DELAY models solver/device latency, so work
+    REDISTRIBUTION is observable on a single shared CPU) plus three
+    featherweights that drain the other rank fast. Contract-level
+    stealing is disabled (--no-steal) in BOTH runs so any balance comes
+    from intra-contract wave sharding alone. Returns the gate dict;
+    the caller fails the smoke unless:
+
+    * the merged issue set is IDENTICAL with migration on vs off;
+    * at least one batch actually migrated (batches_out/in > 0);
+    * the thief registered shipped verdicts (verdicts_replayed > 0)
+      and banked solver reuse (queries_saved > 0);
+    * the rigged long pole's max rank wall is <= 1.5x the mean.
+    """
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from tests.fixture_paths import INPUTS
+
+    tmp = Path(tempfile.mkdtemp(prefix="mtpu_steal_smoke_"))
+    heavy, light = "ether_send.sol.o", "nonascii.sol.o"
+    files = []
+    for name in (f"a_{heavy}", f"b_{light}", f"c_{light}",
+                 f"d_{light}"):
+        dst = tmp / name
+        shutil.copy(INPUTS / name.split("_", 1)[1], dst)
+        files.append(str(dst))
+
+    def _run(out_name, migrate):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out_dir = tmp / out_name
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            # the long pole: ~0.4 s per completed path on every rank
+            # (work is latency-shaped wherever it runs), mid-round
+            # polls every 64 processed states
+            env["MTPU_PATH_DELAY"] = "0.4"
+            env["MTPU_MIDROUND_K"] = "64"
+            cmd = [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+                   "--coordinator", f"127.0.0.1:{port}",
+                   "--num-processes", "2", "--process-id", str(rank),
+                   "--out-dir", str(out_dir), "--timeout", "60",
+                   "--no-steal"]
+            if migrate:
+                cmd.append("--migrate")
+            procs.append(subprocess.Popen(
+                cmd + files, cwd=str(Path(__file__).resolve().parent),
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=300) for p in procs]
+        for p, (_, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"steal-smoke rank failed:\n{err[-2000:]}")
+        return json.loads(
+            (out_dir / "corpus_report.json").read_text())
+
+    def _canon(report):
+        return [(c["contract"], c.get("issues"), c.get("swc"))
+                for c in report["contracts"]]
+
+    t0 = time.perf_counter()
+    try:
+        plain = _run("plain", migrate=False)
+        moved = _run("migrate", migrate=True)
+    except Exception as e:
+        shutil.rmtree(tmp, ignore_errors=True)
+        return {"error": type(e).__name__, "detail": str(e)[:500],
+                "ok": False}
+    wall = round(time.perf_counter() - t0, 1)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    thief = [s for s in moved["shards"]
+             if s["migration"].get("batches_in", 0) > 0]
+    gates = {
+        "reports_identical": _canon(plain) == _canon(moved),
+        "batches_migrated": moved.get("batches_out", 0) > 0
+        and moved.get("batches_in", 0) > 0,
+        "thief_verdicts_replayed": sum(
+            s["solver"].get("verdicts_replayed", 0)
+            for s in thief) > 0,
+        "thief_queries_saved": sum(
+            s["solver"].get("queries_saved", 0) for s in thief) > 0,
+        "wall_balanced": moved.get("wall_imbalance", 99.0) <= 1.5,
+    }
+    return {
+        "wall_s": wall,
+        "plain_walls": [s["wall_s"] for s in plain["shards"]],
+        "migrate_walls": [s["wall_s"] for s in moved["shards"]],
+        "wall_imbalance": moved.get("wall_imbalance"),
+        "states_migrated": moved.get("states_migrated", 0),
+        "batches_out": moved.get("batches_out", 0),
+        "batches_in": moved.get("batches_in", 0),
+        "midround_exports": moved.get("midround_exports", 0),
+        "steal_latency_s": max(
+            (s["migration"].get("steal_latency_s", 0.0)
+             for s in moved["shards"]), default=0.0),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
 def bench_smoke():
-    """`bench.py --smoke`: CI-fast (<60 s on this box) visibility run
+    """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Three stages:
+    run-wide verdict cache — NO full corpus sweep. Four stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -757,7 +871,12 @@ def bench_smoke():
        cached-path verdicts re-derived through plain `is_possible`
        with the cache disabled. ANY disagreement exits 1 (a cached
        verdict that diverges from the direct pipeline is a soundness
-       bug, not a perf regression).
+       bug, not a perf regression);
+    4. a two-rank local steal over a rigged long-pole corpus
+       (_smoke_steal, docs/work_stealing.md): merged-report identity
+       with the migration bus on vs off, at least one migrated batch,
+       shipped verdicts registering as the thief's queries_saved, and
+       a max-rank wall within 1.5x the mean. Any miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -873,6 +992,13 @@ def bench_smoke():
         reuse, reuse_total=reuse_total,
         spot_check={"sampled": len(sample), "mismatches": mismatches})
 
+    # stage 4: the work-sharding steal gate (subprocess two-rank run;
+    # skippable for the quick inner-loop via MTPU_SMOKE_STEAL=0)
+    if os.environ.get("MTPU_SMOKE_STEAL", "1") != "0":
+        out["steal"] = _smoke_steal()
+    else:
+        out["steal"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -885,7 +1011,10 @@ def bench_smoke():
           # cached verdict disagreeing with direct is_possible is an
           # instant failure (soundness, not perf)
           and reuse_total > 0
-          and mismatches == 0)
+          and mismatches == 0
+          # the steal gate: identical reports, real migration, shipped
+          # verdicts banked on the thief, balanced rank walls
+          and out["steal"].get("ok", False))
     return 0 if ok else 1
 
 

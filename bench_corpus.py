@@ -84,12 +84,24 @@ def main():
         results.append(r)
         print(json.dumps(r), flush=True)
     total = time.perf_counter() - t0
-    print(json.dumps({
+    agg = {
         "corpus": len(results),
         "total_wall_s": round(total, 1),
         "total_issues": sum(r.get("issues", 0) for r in results),
         "errors": sum(1 for r in results if "error" in r),
-    }))
+    }
+    try:
+        # the solver-layer counter block (batched discharge, verdict
+        # cache, shipped/replayed proofs) — same visibility the
+        # multi-rank corpus shard reports carry
+        from mythril_tpu.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
+        agg["solver"] = SolverStatistics().batch_counters()
+    except Exception:
+        pass
+    print(json.dumps(agg))
 
 
 if __name__ == "__main__":

@@ -598,11 +598,14 @@ def _unique_table(st: SymLaneState, canon_pid, d_recs: int, urb: int):
     self_pid = -(jnp.arange(n)[:, None] * d_recs
                  + jnp.arange(d_recs)[None, :] + 1)
     is_canon = (live & (canon_pid == self_pid)).reshape(-1)
-    order = jnp.cumsum(is_canon) - 1
     ucount = jnp.sum(is_canon.astype(jnp.int32))
-    rows = jnp.full((urb,), 0, jnp.int32)
-    rows = rows.at[jnp.where(is_canon, order, urb)].set(
-        jnp.arange(n * d_recs), mode="drop")
+    # first-urb selection via sort (ascending flat order; padding
+    # clamps to row 0 as before — the host reads only ucount rows):
+    # the cumsum+scatter form mis-partitions under a mesh when the
+    # index count equals the operand length (see pick_mesh)
+    rows = jnp.sort(jnp.where(is_canon, jnp.arange(n * d_recs),
+                              n * d_recs))[:urb]
+    rows = jnp.where(rows < n * d_recs, rows, 0)
     l, sl = rows // d_recs, rows % d_recs
     tab = jnp.concatenate([
         l[:, None], sl[:, None], st.dlog_op[l, sl][:, None],
@@ -869,18 +872,17 @@ def _window_exec(st: SymLaneState, cc, i32buf, u8buf, exec_table,
         & (st.msize <= RESUME_MEM) & (st.mlog_count <= RESUME_MLOG))
     horder = jnp.cumsum(hold.astype(jnp.int32)) - 1
     hold = hold & (horder < hcap)  # excess candidates retire instead
-    hidx = jnp.full((hcap,), n, jnp.int32)
-    hidx = hidx.at[jnp.where(hold, horder, hcap)].set(
-        jnp.where(hold, jnp.arange(n), n).astype(jnp.int32),
-        mode="drop")
+    # selection-to-bucket via sort (ascending lane order == cumsum
+    # order, padding n sorts last): a scatter whose index count equals
+    # the plane length mis-partitions under a mesh (see pick_mesh)
+    hidx = jnp.sort(
+        jnp.where(hold, jnp.arange(n), n).astype(jnp.int32))[:hcap]
     hrows = _resume_gather_core(st, jnp.clip(hidx, 0, n - 1))
     elig = parked & fits & ~hold
     order = jnp.cumsum(elig.astype(jnp.int32)) - 1
     take = elig & (order < rcap)
-    ridx = jnp.full((rcap,), n, jnp.int32)
-    ridx = ridx.at[jnp.where(take, order, rcap)].set(
-        jnp.where(take, jnp.arange(n), n).astype(jnp.int32),
-        mode="drop")
+    ridx = jnp.sort(
+        jnp.where(take, jnp.arange(n), n).astype(jnp.int32))[:rcap]
     rc = jnp.clip(ridx, 0, n - 1)
     rows = _retire_gather_core(st, rc, rcap, dstack, dmem, dmlog,
                                dslot)
@@ -1251,7 +1253,13 @@ def pick_mesh(width: int):
     for single-device execution. Auto (-1) shards over every local
     device when more than one exists; the width must divide evenly and
     leave at least 8 lanes per shard (narrower shards pay collective
-    overhead for no batching win). Single-chip hosts — including the
+    overhead for no batching win). A 16-lane engine sharded 2x8 used
+    to trip an XLA SPMD partitioner bug — the select-to-bucket
+    cumsum+scatter sites whose index count equals the plane length
+    partitioned their operand but not their indices, failing HLO
+    verification ("updates bound is 8, scatter_indices bound is 16");
+    those sites now select via sort (see _unique_table/_window_exec),
+    which partitions cleanly. Single-chip hosts — including the
     tunneled-TPU driver environment — always resolve to None."""
     from ..support.support_args import args
 
@@ -1402,6 +1410,14 @@ class LaneEngine:
         self._resume_flag = jnp.asarray(
             1 if self.resume_on else 0, jnp.int32)
         self.last_run_stats: Optional[dict] = None
+
+    def _full_bucket(self) -> int:
+        """Full-width seed bucket for backlog drains, kept strictly
+        below the plane width under a mesh: a k == n seed scatter
+        trips the SPMD partitioner (operand sharded, indices not —
+        see pick_mesh)."""
+        return self.n_lanes if self.mesh is None \
+            else max(self.n_lanes // 2, 1)
 
     # -- seeding ------------------------------------------------------------
     # (eligibility is decided by the caller: svm._lane_engine_sweep)
@@ -1597,6 +1613,12 @@ class LaneEngine:
         # drains seed floods in one window. explore() only requests
         # `big` once that variant is warm.
         k = n if big else min(16, n)
+        if self.mesh is not None and k >= n and n > 1:
+            # a k == n seed scatter trips the SPMD partitioner (the
+            # plane operand shards, the index vector stays replicated
+            # — see pick_mesh); keep the bucket strictly below the
+            # plane width and drain floods over two windows instead
+            k = max(n // 2, 1)
         assert len(lanes) <= k and len(resumes) <= k
 
         idx = np.full(k, n, np.int32)  # padding -> out of range -> drop
@@ -2364,6 +2386,13 @@ class LaneEngine:
         kill: List[int] = []
         resumes: List[tuple] = []
         small = min(16, self.n_lanes)
+        if self.mesh is not None and small >= self.n_lanes:
+            # under a mesh the seed bucket stays strictly BELOW the
+            # plane width: a k == n seed scatter trips the SPMD
+            # partitioner (operand sharded, indices not — see
+            # pick_mesh); half-plane seeding costs one extra window
+            # only on narrow meshed engines
+            small = max(self.n_lanes // 2, 1)
         peak_demand = len(queue)
         # one-deep drain pipeline (double-buffered windows): window k's
         # retire-row PULL and the GlobalState rebuilds for its retired
@@ -2419,13 +2448,14 @@ class LaneEngine:
                 # once that variant is compiled (warm_variant kicks a
                 # background compile and the small bucket carries on)
                 seed_cap = small
+                full_bucket = self._full_bucket()
                 if (len(queue) > small or len(resumes) > small) \
-                        and warm_variant(
+                        and full_bucket > small and warm_variant(
                     self.n_lanes, len(code_bytes), self.lane_kwargs,
                     self.window, self.step_budget,
-                    seed_bucket=self.n_lanes,
+                    seed_bucket=full_bucket,
                 ):
-                    seed_cap = self.n_lanes
+                    seed_cap = full_bucket
                 entries = []
                 while queue and free and len(entries) < seed_cap:
                     gs = queue.popleft()
@@ -2580,12 +2610,14 @@ class LaneEngine:
                 # the supplementary dispatch afterwards).
                 held = [int(x) for x in hidx if x < n]
                 cap_r = small
-                if len(held) > small and warm_variant(
+                full_r = self._full_bucket()
+                if len(held) > small and full_r > small \
+                        and warm_variant(
                     self.n_lanes, len(code_bytes),
                     self.lane_kwargs, self.window,
-                    self.step_budget, seed_bucket=self.n_lanes,
+                    self.step_budget, seed_bucket=full_r,
                 ):
-                    cap_r = self.n_lanes
+                    cap_r = full_r
                 held = held[:cap_r]
                 if held:
                     held_set = set(held)

@@ -100,5 +100,15 @@ class BenchmarkPlugin(LaserPlugin):
                 counters["verdict_bound_seeds"],
                 counters["queries_saved"],
             )
+            # migration-bus verdict shipping (docs/work_stealing.md):
+            # proofs exported with stolen batches / replayed from a
+            # victim's sidecar before a resume
+            if counters["verdicts_shipped"] or \
+                    counters["verdicts_replayed"]:
+                log.info(
+                    "Verdict shipping: shipped=%d replayed=%d",
+                    counters["verdicts_shipped"],
+                    counters["verdicts_replayed"],
+                )
         except Exception:  # telemetry only, never an error path
             pass

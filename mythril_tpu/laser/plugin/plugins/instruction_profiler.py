@@ -90,6 +90,14 @@ class InstructionProfiler(LaserPlugin):
                     counters["verdict_bound_seeds"],
                     counters["queries_saved"],
                 ))
+            # migration-bus verdict shipping (docs/work_stealing.md)
+            if counters["verdicts_shipped"] or \
+                    counters["verdicts_replayed"]:
+                lines.append(
+                    "Verdict shipping: shipped={} replayed={}".format(
+                        counters["verdicts_shipped"],
+                        counters["verdicts_replayed"],
+                    ))
         except Exception:  # telemetry only
             pass
         for r in sorted(

@@ -10,7 +10,9 @@ before falling back to per-state solver checks (see
 mythril_tpu/models/pruner.py)."""
 
 import logging
+import os
 import random
+import time
 from abc import ABCMeta
 from collections import defaultdict
 from copy import copy
@@ -82,6 +84,12 @@ class LaserEVM:
 
         self.time: Optional[datetime] = None
         self.executed_transactions: bool = False
+        # test/bench rig: seconds slept per completed top-level path
+        # (corpus steal smokes and migration tests model per-path
+        # solver/device latency with it so work REDISTRIBUTION is
+        # observable on a single shared CPU; see docs/work_stealing.md)
+        self._path_delay = float(
+            os.environ.get("MTPU_PATH_DELAY", "0") or 0)
         # checkpoint/resume seam (support/checkpoint.py): first unrun
         # round, and the per-round snapshot callback
         self.start_round: int = 0
@@ -259,6 +267,13 @@ class LaserEVM:
                         func_hashes[itr] = bytes.fromhex(
                             hex(func_hash)[2:].zfill(8)
                         )
+            # round context for the migration bus's MID-ROUND yield
+            # (parallel/migrate.py): states finishing round i await
+            # round i+1, so a slice exported while round i still runs
+            # resumes at i+1 on the thief
+            bus = getattr(args, "migration_bus", None)
+            if bus is not None:
+                bus.begin_round(i + 1, self.transaction_count, address)
             for hook in self._start_sym_trans_hooks:
                 hook()
             execute_message_call(self, address, func_hashes=func_hashes)
@@ -591,6 +606,14 @@ class LaserEVM:
             self._lane_engine_sweep()
 
         iter_since_sweep = 0
+        # mid-round work sharding (parallel/migrate.py): poll the
+        # steal-request flag every K processed states so a long-pole
+        # contract sheds finished open states WHILE a round runs, not
+        # only at its boundary. K comes from the bus (splittable
+        # contracts poll more often).
+        bus = None if create or track_gas else getattr(
+            args, "migration_bus", None)
+        midround_tick = 0
         try:
             for global_state in self.strategy:
                 if create and self._check_create_termination():
@@ -635,6 +658,11 @@ class LaserEVM:
                 elif track_gas:
                     final_states.append(global_state)
                 self.total_states += len(new_states)
+                if bus is not None:
+                    midround_tick += 1
+                    if midround_tick >= bus.yield_every:
+                        midround_tick = 0
+                        bus.midround_yield(self)
                 # fork-scale history also fills from HOST exploration:
                 # the engagement gate (lane_engine.device_break_even)
                 # flips for a demonstrably wide-forking code on the
@@ -972,6 +1000,8 @@ class LaserEVM:
                 hook(global_state)
             except PluginSkipWorldState:
                 return
+        if self._path_delay:
+            time.sleep(self._path_delay)
         self.open_states.append(global_state.world_state)
 
     # -- CFG ----------------------------------------------------------------

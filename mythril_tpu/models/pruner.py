@@ -98,11 +98,47 @@ def _device_succeeded() -> None:
     _device_failures = 0
 
 
+def _verdict_kills(open_states: List) -> List:
+    """Exact/ancestor verdict kills BEFORE any screen: prior-window
+    proofs and migration-sidecar replays (docs/work_stealing.md) drop
+    states with zero interval or solver work. Without this the device
+    screen path bypasses the run-wide cache entirely, so a thief would
+    re-screen constraint sets its victim already refuted. Shadow tier
+    deliberately skipped — this pass must stay O(lookup) per state."""
+    try:
+        from ..smt.solver import verdicts
+
+        vc = verdicts.cache()
+        if vc is None:
+            return open_states
+        out = []
+        for ws in open_states:
+            try:
+                raws = [c.raw for c in
+                        _all_constraints(ws.constraints)
+                        if type(c) != bool]
+                verdict, _ = vc.probe(raws, shadow=False)
+            except Exception:
+                verdict = None
+            if verdict != verdicts.UNSAT:
+                out.append(ws)
+        return out
+    except Exception:
+        return open_states
+
+
 def prefilter_world_states(open_states: List) -> List:
     """Drop world states with an interval-infeasible constraint. Sound:
     only provably-unsat states are removed."""
     from ..support.devices import effective_tpu_lanes
 
+    kept = _verdict_kills(open_states)
+    if len(kept) < len(open_states):
+        STATS["screened"] += len(open_states) - len(kept)
+        STATS["pruned"] += len(open_states) - len(kept)
+        log.info("verdict-cache pre-pass dropped %d open states",
+                 len(open_states) - len(kept))
+    open_states = kept
     if (
         effective_tpu_lanes()
         and len(open_states) >= _device_threshold()

@@ -52,6 +52,13 @@ def init_distributed(coordinator: Optional[str] = None,
         num_processes=num_processes,
         process_id=process_id,
     )
+    # force the collective backend handshake NOW, while every rank is
+    # at the same startup point: in a multi-process group the FIRST
+    # backend creation blocks until all ranks arrive, so a lazy first
+    # jax touch deep inside one rank's analysis would silently
+    # serialize the whole corpus (each rank stalls at another's pace
+    # instead of draining early and stealing work)
+    jax.devices()
     return process_id
 
 
@@ -64,15 +71,43 @@ def shard_corpus(paths: Sequence[str], process_id: int,
             if i % num_processes == process_id]
 
 
-def _barrier(name: str) -> None:
-    """Group-wide barrier riding the DCN collective transport."""
-    from jax.experimental import multihost_utils
+#: coordination-barrier timeout: generous — ranks arrive as their
+#: shards finish, and the slowest shard bounds the spread
+_BARRIER_TIMEOUT_MS = int(
+    os.environ.get("MTPU_BARRIER_TIMEOUT_MS", str(30 * 60 * 1000)))
 
-    multihost_utils.sync_global_devices(name)
+
+def _barrier(name: str) -> None:
+    """Group-wide barrier over the coordinator's DCN channel.
+
+    Rides the coordination-service barrier directly (works on every
+    backend); the previous sync_global_devices path is a DEVICE
+    collective that current jaxlib rejects on multi-process CPU groups
+    ("Multiprocess computations aren't implemented on the CPU
+    backend"). Falls back to the device collective only when a process
+    group exists without a coordination client; standalone runs are a
+    no-op."""
+    client = None
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+    if client is not None:
+        client.wait_at_barrier(name, _BARRIER_TIMEOUT_MS)
+        return
+    import jax
+
+    if jax.process_count() > 1:  # pragma: no cover - TPU pods
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
 
 
 def default_analyze(path: str, timeout: int = 60,
-                    tpu_lanes: int = 0, bus=None) -> dict:
+                    tpu_lanes: int = 0, bus=None,
+                    stats: Optional[dict] = None) -> dict:
     """One contract end to end with the full default detector set.
 
     MTPU_ANALYZE_DELAY (test support): extra sleep per contract,
@@ -104,6 +139,14 @@ def default_analyze(path: str, timeout: int = 60,
     disassembler = MythrilDisassembler(eth=None)
     code = Path(path).read_text().strip()
     address, _ = disassembler.load_from_bytecode(code, bin_runtime=True)
+    contract = disassembler.contracts[-1]
+    if stats:
+        # persisted fork peak seeds lane_engine.PATH_HISTORY so
+        # pick_width engages a wide engine on the FIRST sweep of a
+        # known wide-forking contract (parallel/cost_model.py)
+        from .cost_model import warm_path_history
+
+        warm_path_history(contract.disassembly, Path(path).name, stats)
     cmd_args = make_cmd_args(execution_timeout=timeout,
                              tpu_lanes=tpu_lanes,
                              migration_bus=bus)
@@ -113,7 +156,7 @@ def default_analyze(path: str, timeout: int = 60,
     )
     migrated = 0
     if bus is not None:
-        bus.begin_contract(path, disassembler.contracts[-1])
+        bus.begin_contract(path, contract)
     report = analyzer.fire_lasers(modules=None, transaction_count=2)
     if bus is not None:
         # merge issues from batches other ranks analyzed for us —
@@ -125,6 +168,11 @@ def default_analyze(path: str, timeout: int = 60,
         "issues": len(issues),
         "swc": sorted({i["swc-id"] for i in issues}),
     }
+    from .cost_model import observed_fork_peak
+
+    peak = observed_fork_peak(contract.disassembly)
+    if peak:
+        out["fork_peak"] = peak
     if migrated:
         out["migrated_batches"] = migrated
     return out
@@ -137,7 +185,9 @@ def _kv_client():
         from jax._src import distributed
 
         client = distributed.global_state.client
-        if client is not None and hasattr(client, "key_value_increment"):
+        if client is not None and (
+                hasattr(client, "key_value_increment")
+                or hasattr(client, "key_value_set")):
             return client
     except Exception:
         pass
@@ -145,15 +195,24 @@ def _kv_client():
 
 
 def _claim(client, item: str, owner: bool) -> bool:
-    """Atomically claim a work item group-wide: the coordinator's
-    key_value_increment is an atomic fetch-add, so exactly one rank
-    sees 1. On a degraded coordinator the OWNER keeps its shard (work
-    must never be dropped; the worst case is duplicate analysis, which
-    the merge dedups) while thieves claim nothing."""
+    """Atomically claim a work item group-wide. Newer jax exposes the
+    coordinator's atomic fetch-add (key_value_increment: exactly one
+    rank sees 1); older builds (e.g. 0.4.37) only have key_value_set,
+    whose allow_overwrite=False default REJECTS a second insert — so
+    exactly one rank's set succeeds and the rest see ALREADY_EXISTS.
+    On a degraded coordinator the OWNER keeps its shard (work must
+    never be dropped; the worst case is duplicate analysis, which the
+    merge dedups) while thieves claim nothing."""
+    key = f"mtpu_claim:{item}"
     try:
-        return client.key_value_increment(f"mtpu_claim:{item}", 1) == 1
-    except Exception as e:  # pragma: no cover - degraded coordinator
-        log.warning("work-claim failed (%s); %s", e,
+        if hasattr(client, "key_value_increment"):
+            return client.key_value_increment(key, 1) == 1
+        client.key_value_set(key, "1")
+        return True
+    except Exception as e:
+        if "exists" in str(e).lower():  # lost the claim race
+            return False
+        log.warning("work-claim failed (%s); %s", e,  # pragma: no cover
                     "owner keeps the item" if owner
                     else "not stealing")
         return owner
@@ -173,12 +232,24 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     into corpus_report.json."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
-    shard = shard_corpus(paths, process_id, num_processes)
+    # cost-aware LPT when a prior run left stats.json in --out-dir,
+    # deterministic round-robin otherwise; long-pole contracts above
+    # the perfect-balance share are pre-declared splittable so the
+    # migration bus sheds their waves aggressively
+    # (parallel/cost_model.py, docs/work_stealing.md)
+    from .cost_model import load_stats, make_shards
+
+    stats = load_stats(out)
+    shards, splittable = make_shards(paths, num_processes, stats)
+    shard = shards[process_id]
+    if bus is not None:
+        bus.splittable = set(splittable)
     client = _kv_client() if num_processes > 1 else None
     results = []
     t0 = time.perf_counter()
 
     def _run_one(path, stolen_from=None):
+        t_c = time.perf_counter()
         try:
             r = analyze(path)
         except Exception as e:  # keep sweeping — reference parity with
@@ -186,6 +257,7 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
             log.warning("analysis of %s failed: %s", path, e)
             r = {"contract": Path(path).name, "error": type(e).__name__}
         r["path"] = str(path)  # merge dedups on the full path
+        r.setdefault("wall_s", round(time.perf_counter() - t_c, 3))
         if stolen_from is not None:
             r["stolen_from"] = stolen_from
         results.append(r)
@@ -202,8 +274,7 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
         for victim in range(num_processes):
             if victim == process_id:
                 continue
-            for path in reversed(shard_corpus(paths, victim,
-                                              num_processes)):
+            for path in reversed(shards[victim]):
                 if _claim(client, path, owner=False):
                     log.info("rank %d: stole %s from rank %d",
                              process_id, path, victim)
@@ -226,6 +297,17 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
             r.get("migrated_batches", 0) for r in results),
         "results": results,
     }
+    if bus is not None:
+        shard_report["migration"] = dict(bus.stats)
+    try:
+        # this rank's solver counter block (verdict-cache reuse,
+        # shipped/replayed proofs, queries_saved) — the steal smoke
+        # gates on the THIEF's queries_saved being positive
+        from ..smt.solver.solver_statistics import SolverStatistics
+
+        shard_report["solver"] = SolverStatistics().batch_counters()
+    except Exception:  # telemetry only
+        pass
     (out / f"shard_{process_id}.json").write_text(
         json.dumps(shard_report))
     _barrier("mythril_tpu_corpus_done")
@@ -252,7 +334,9 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
              "migrated_batches_served":
                  data.get("migrated_batches_served", 0),
              "migrated_batches_out":
-                 data.get("migrated_batches_out", 0)})
+                 data.get("migrated_batches_out", 0),
+             "migration": data.get("migration", {}),
+             "solver": data.get("solver", {})})
         merged["stolen"] += data.get("stolen", 0)
         for r in data["results"]:
             key = r.get("path", r["contract"])
@@ -263,7 +347,24 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
             merged["total_issues"] += r.get("issues", 0)
             merged["errors"] += 1 if "error" in r else 0
     merged["contracts"].sort(key=lambda r: r["contract"])
+    # per-rank wall imbalance: 1.0 = perfect balance, and the makespan
+    # metric the work-sharding scheduler is judged on (ISSUE 3 gates
+    # max <= 1.5x mean on the rigged long-pole corpus)
+    walls = [s["wall_s"] for s in merged["shards"]] or [0.0]
+    mean = sum(walls) / len(walls)
+    merged["wall_imbalance"] = round(max(walls) / mean, 3) \
+        if mean > 0 else 1.0
+    for key in ("states_migrated", "batches_out", "batches_in",
+                "midround_exports"):
+        merged[key] = sum(s["migration"].get(key, 0)
+                          for s in merged["shards"])
     (out / "corpus_report.json").write_text(json.dumps(merged))
+    # persist per-contract walls + fork peaks: the NEXT run over this
+    # --out-dir seeds its LPT schedule and pick_width warm start from
+    # them (parallel/cost_model.py)
+    from .cost_model import save_stats
+
+    save_stats(out, merged["contracts"])
     return merged
 
 
@@ -303,11 +404,14 @@ def main(argv=None) -> int:
         bus = MigrationBus(args.out_dir, rank, num_processes,
                            timeout=args.timeout,
                            tpu_lanes=args.tpu_lanes)
+    from .cost_model import load_stats
+
+    stats = load_stats(args.out_dir)
     report = run_corpus(
         args.files, args.out_dir, rank, num_processes,
         analyze=lambda p: default_analyze(
             p, timeout=args.timeout, tpu_lanes=args.tpu_lanes,
-            bus=bus),
+            bus=bus, stats=stats),
         steal=not args.no_steal, bus=bus,
     )
     print(json.dumps(report))

@@ -1,0 +1,173 @@
+"""Per-contract cost model for corpus scheduling (docs/work_stealing.md).
+
+BENCH_r05 showed the corpus makespan pinned at `max(contract walls)`:
+per-contract LPT cannot scale past the slowest contract, exactly the
+per-program cost skew path explosion induces in bounded symbolic
+execution. This module supplies the planning half of the fix:
+
+* **stats persistence** — after every corpus run, rank 0 writes
+  ``--out-dir/stats.json`` with each contract's measured wall time and
+  fork-peak (the PATH_HISTORY worklist peak), merged over prior runs
+  (wall: exponential moving average; fork peak: running max).
+* **cost prediction** — the next run over the same ``--out-dir`` seeds
+  per-contract cost estimates from the persisted walls (unknown
+  contracts get the known median), refined online from first-round
+  fork counts by the migration bus.
+* **LPT-with-splitting schedule** — contracts sort by predicted cost
+  descending onto the least-loaded rank (deterministic: every rank
+  computes the same assignment from the same stats file, no
+  communication). Contracts predicted above ``total / n_ranks`` are
+  pre-declared SPLITTABLE: no static schedule can amortize them, so
+  the migration bus sheds their open-state waves aggressively
+  (mid-round, multi-way — parallel/migrate.py) instead of waiting for
+  a thief to ask at a round boundary.
+* **pick_width warm start** — persisted fork peaks seed
+  ``lane_engine.PATH_HISTORY`` so the first sweep of a known
+  wide-forking contract engages a wide engine (and the tunneled
+  break-even gate) without re-learning the fork scale.
+"""
+
+import json
+import logging
+import os
+import tempfile
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+log = logging.getLogger(__name__)
+
+STATS_NAME = "stats.json"
+
+#: wall-time EMA weight for the newest observation
+_EMA_ALPHA = 0.5
+
+
+def load_stats(out_dir) -> Dict[str, dict]:
+    """{contract basename: {"wall_s": float, "fork_peak": int}} from a
+    prior run's stats file, or {} when absent/corrupt."""
+    path = Path(out_dir) / STATS_NAME
+    try:
+        if not path.exists():
+            return {}
+        data = json.loads(path.read_text())
+        contracts = data.get("contracts", {})
+        return {str(k): v for k, v in contracts.items()
+                if isinstance(v, dict)}
+    except Exception as e:
+        log.warning("stats load failed (%s); scheduling cold", e)
+        return {}
+
+
+def save_stats(out_dir, results: Sequence[dict]) -> None:
+    """Merge this run's per-contract observations into stats.json
+    (atomic replace; best-effort). `results` rows carry ``contract``
+    (basename), ``wall_s``, and optionally ``fork_peak``."""
+    out = Path(out_dir)
+    prior = load_stats(out)
+    for r in results:
+        name = r.get("contract")
+        wall = r.get("wall_s")
+        if not name or wall is None:
+            continue
+        entry = prior.setdefault(name, {})
+        old = entry.get("wall_s")
+        entry["wall_s"] = round(
+            wall if old is None
+            else _EMA_ALPHA * wall + (1 - _EMA_ALPHA) * old, 3)
+        peak = int(r.get("fork_peak", 0) or 0)
+        entry["fork_peak"] = max(peak, int(entry.get("fork_peak", 0)))
+    try:
+        fd, tmp = tempfile.mkstemp(dir=str(out), prefix=".stats-")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"version": 1, "contracts": prior}, f)
+        os.replace(tmp, out / STATS_NAME)
+    except Exception as e:  # pragma: no cover - best-effort by design
+        log.warning("stats save failed (%s)", e)
+
+
+def predict_costs(paths: Sequence[str],
+                  stats: Dict[str, dict]) -> Optional[Dict[str, float]]:
+    """{path: predicted wall seconds}; None when no contract in the
+    corpus has a prior (the caller falls back to round-robin, which
+    stays deterministic with zero information)."""
+    known = {}
+    for p in paths:
+        entry = stats.get(Path(p).name)
+        if entry and entry.get("wall_s") is not None:
+            known[p] = max(float(entry["wall_s"]), 1e-3)
+    if not known:
+        return None
+    ordered = sorted(known.values())
+    median = ordered[len(ordered) // 2]
+    return {p: known.get(p, median) for p in paths}
+
+
+def lpt_schedule(paths: Sequence[str], costs: Dict[str, float],
+                 num_processes: int) -> List[List[str]]:
+    """Longest-processing-time-first assignment onto `num_processes`
+    ranks; ties break on the sorted path so every rank derives the
+    identical schedule independently."""
+    loads = [0.0] * num_processes
+    shards: List[List[str]] = [[] for _ in range(num_processes)]
+    for p in sorted(paths, key=lambda p: (-costs[p], p)):
+        r = min(range(num_processes), key=lambda i: (loads[i], i))
+        shards[r].append(p)
+        loads[r] += costs[p]
+    return shards
+
+
+def splittable_set(paths: Sequence[str], costs: Dict[str, float],
+                   num_processes: int) -> Set[str]:
+    """Contracts predicted above the perfect-balance share
+    ``total / n_ranks``: the long poles no static schedule can
+    amortize — pre-declared for aggressive intra-contract sharding."""
+    if num_processes <= 1 or not paths:
+        return set()
+    fair = sum(costs[p] for p in paths) / num_processes
+    return {p for p in paths if costs[p] > fair}
+
+
+def make_shards(paths: Sequence[str], num_processes: int,
+                stats: Optional[Dict[str, dict]] = None,
+                ) -> Tuple[List[List[str]], Set[str]]:
+    """(per-rank shards, splittable paths). Cost-aware LPT when any
+    prior exists, deterministic round-robin otherwise — both computed
+    identically on every rank without communication."""
+    costs = predict_costs(paths, stats or {})
+    if costs is None:
+        ordered = sorted(paths)
+        return ([[p for i, p in enumerate(ordered)
+                  if i % num_processes == r]
+                 for r in range(num_processes)], set())
+    return (lpt_schedule(paths, costs, num_processes),
+            splittable_set(paths, costs, num_processes))
+
+
+def warm_path_history(disassembly, name: str,
+                      stats: Dict[str, dict]) -> None:
+    """Seed lane_engine.PATH_HISTORY (pick_width / device_break_even)
+    from a persisted fork peak, best-effort."""
+    entry = stats.get(name)
+    peak = int((entry or {}).get("fork_peak", 0) or 0)
+    if peak <= 0:
+        return
+    try:
+        from ..laser.lane_engine import PATH_HISTORY, code_to_bytes
+
+        code = code_to_bytes(disassembly)
+        if code and peak > PATH_HISTORY.get(code, 0):
+            PATH_HISTORY[code] = peak
+    except Exception:  # pragma: no cover - lane path optional
+        pass
+
+
+def observed_fork_peak(disassembly) -> int:
+    """The PATH_HISTORY peak recorded for a contract's code during this
+    process's analyses (0 when none / lane path unavailable)."""
+    try:
+        from ..laser.lane_engine import PATH_HISTORY, code_to_bytes
+
+        code = code_to_bytes(disassembly)
+        return int(PATH_HISTORY.get(code, 0)) if code else 0
+    except Exception:  # pragma: no cover
+        return 0

@@ -1,35 +1,61 @@
-"""Cross-host PATH-BATCH migration for corpus mode (SURVEY §2.10,
-distributed-backend row: work moves between hosts over DCN when a
-shard drains early — not just unstarted contracts, but the open-state
-wave of a HALF-FINISHED analysis).
+"""Cost-aware intra-contract work sharding for corpus mode
+(docs/work_stealing.md; SURVEY §2.10, distributed-backend row: work
+moves between hosts over DCN when a shard drains early — not just
+unstarted contracts, but the open-state wave of a HALF-FINISHED
+analysis).
 
-Mechanism: at each symbolic transaction-round boundary the engine's
-open world states collapse to the serializable core the checkpoint
-format already carries (support/checkpoint.py: flat term-table,
-keccak-manager state, tx counter). A loaded victim answers a drained
-thief's request by exporting HALF its open states as a checkpoint-
-format batch; the thief resumes it through the ordinary checkpoint
-machinery (same contract, same remaining rounds) with its own engine
-and detector set, then ships the issues it found back. The victim
-merges them through Report.append_issue — the same dedup path an
-unsplit analysis uses — so the merged report is identical to a
+Mechanism: the engine's open world states collapse to the serializable
+core the checkpoint format already carries (support/checkpoint.py:
+flat term-table, keccak-manager state, tx counter). A loaded victim
+answers drained thieves by exporting slices of its open states as
+checkpoint-format batches; a thief resumes one through the ordinary
+checkpoint machinery (same contract, same remaining rounds) with its
+own engine and detector set, then ships the issues it found back. The
+victim merges them through Report.append_issue — the same dedup path
+an unsplit analysis uses — so the merged report is identical to a
 no-migration run.
+
+Three scheduler upgrades over the original reactive halving bus:
+
+* **mid-round yield** — the victim's exploration loop polls the
+  steal-request flag every K processed states (laser/svm.py), so open
+  states that finished the current transaction round migrate while
+  the round is still running, not only at its boundary: a long-pole
+  contract sheds work during its first round.
+* **multi-way offers** — the wave splits proportionally across ALL
+  idle ranks (k trailing slices, one offer each) instead of halving
+  to one thief; the O_CREAT|O_EXCL claim protocol and the dead-thief
+  local-resume fallback apply per offer, so k batches generalize for
+  free.
+* **verdict-cache shipping** — each batch carries a sidecar of PR-2
+  verdict-cache proofs (ancestor-UNSAT fingerprints and cached
+  models) restricted to the shipped states' constraint prefixes,
+  re-fingerprinted on the thief's term table at load: the thief never
+  re-proves what the victim already settled (its screen registers
+  them as `queries_saved`).
 
 Coordination rides the corpus mode's shared --out-dir filesystem
 (which rank 0's merge already requires): request/offer/result files
 plus O_CREAT|O_EXCL claim files for atomicity. A crashed thief leaves
 a claimed-but-unanswered offer; the victim falls back to resuming the
 batch locally once every other rank is done or the thief writes a
-failure marker — work can migrate, but never be lost.
+failure marker — work can migrate, but never be lost. While the
+victim is still analyzing it heartbeats its own offer files, and the
+dead-thief clock measures against the freshest of claim and offer
+mtimes: a slow-but-live thief holding a claim is never misclassified
+as dead (and the batch double-executed) just because the victim's
+analysis outlived CLAIMED_WAIT_S.
 
-Tested end-to-end by tests/test_migration.py: a rigged two-rank corpus
-where a mid-flight analysis migrates with identical merged reports.
+Tested end-to-end by tests/test_migration.py: rigged two- and
+four-rank corpora where mid-flight analyses migrate with identical
+merged reports.
 """
 
 import json
 import logging
 import os
 import shutil
+import threading
 import time
 from pathlib import Path
 from typing import List, Optional, Tuple
@@ -39,6 +65,22 @@ log = logging.getLogger(__name__)
 #: how long a victim waits on a CLAIMED offer after every other rank
 #: reported done (a live thief answers in far less; a dead one never)
 CLAIMED_WAIT_S = float(os.environ.get("MTPU_MIGRATE_WAIT", "60"))
+
+#: exploration-loop states processed between steal-request polls
+#: (laser/svm.py mid-round yield); splittable contracts poll 8x as
+#: often — they are the long poles the schedule pre-declared unable
+#: to amortize
+MIDROUND_K = int(os.environ.get("MTPU_MIDROUND_K", "512"))
+
+#: ship verdict-cache sidecars with exported batches (default on;
+#: "0" disables for A/B runs)
+SHIP_VERDICTS = os.environ.get("MTPU_SHIP_VERDICTS", "1") != "0"
+
+#: online cost-model refinement: a contract whose open wave reaches
+#: this many states is a long pole whatever the prior-run stats said —
+#: it flips to the eager (8x) mid-round poll rate for the rest of its
+#: analysis (parallel/cost_model.py handles the prior-seeded half)
+SPLIT_EAGER_FORKS = int(os.environ.get("MTPU_SPLIT_EAGER_FORKS", "128"))
 
 
 def code_identity(contract) -> str:
@@ -75,6 +117,29 @@ class MigrationBus:
         self._offer_seq = 0
         #: set by the victim hook while a contract is being analyzed
         self.current_contract: Optional[str] = None
+        #: contract paths the LPT schedule pre-declared splittable
+        #: (cost above total/n_ranks — parallel/cost_model.py)
+        self.splittable = set()
+        self._split_eager = False
+        #: round context for mid-round yields, set by svm at each
+        #: round start: (next_round, tx_count, address)
+        self._round: Optional[tuple] = None
+        #: shard-report observability (docs/work_stealing.md)
+        self.stats = {
+            "states_migrated": 0,   # open states exported (victim)
+            "batches_out": 0,       # offers published (victim)
+            "batches_in": 0,        # migrated batches served (thief)
+            "midround_exports": 0,  # export waves fired mid-round
+            "steal_latency_s": 0.0,  # request -> first claimed batch
+        }
+        self._req_cache: Optional[tuple] = None
+        self._victim_hb: Optional[_Heartbeat] = None
+
+    @property
+    def yield_every(self) -> int:
+        """svm's mid-round poll period for the CURRENT contract."""
+        return max(MIDROUND_K // 8, 1) if self._split_eager \
+            else MIDROUND_K
 
     # -- signals -------------------------------------------------------------
 
@@ -87,13 +152,19 @@ class MigrationBus:
         except FileNotFoundError:
             pass
 
-    def _pending_requests(self) -> List[int]:
+    def _pending_requests(self, max_age: float = 0.25) -> List[int]:
         """Other ranks' LIVE work requests. A polling thief refreshes
         its request file every loop (and heartbeats it while analyzing
         a batch), so a request untouched for CLAIMED_WAIT_S is a dead
-        rank's leftover and must not gate anyone's local fallback."""
-        out = []
+        rank's leftover and must not gate anyone's local fallback.
+        Results are memoized for `max_age` seconds: the mid-round
+        yield polls every K processed states and must not turn the
+        exploration loop into a glob loop."""
         now = time.time()
+        if (self._req_cache is not None
+                and now - self._req_cache[0] < max_age):
+            return self._req_cache[1]
+        out = []
         for p in self.dir.glob("request_*"):
             rank = int(p.name.split("_")[1])
             if rank == self.rank:
@@ -104,6 +175,7 @@ class MigrationBus:
             except OSError:
                 continue
             out.append(rank)
+        self._req_cache = (now, out)
         return out
 
     def mark_done(self) -> None:
@@ -117,50 +189,170 @@ class MigrationBus:
 
     # -- victim side ---------------------------------------------------------
 
-    def on_round_end(self, laser, next_round: int, tx_count: int,
-                     address) -> None:
-        """svm hook (laser/svm.py _execute_transactions): export half
-        the open states to a drained thief, in place."""
-        if next_round >= tx_count:
-            return  # no rounds left: nothing worth migrating
+    def begin_round(self, next_round: int, tx_count: int,
+                    address) -> None:
+        """svm hook at each transaction-round start: the context a
+        mid-round yield needs to stamp its exported batches."""
+        self._round = (next_round, tx_count, address)
+
+    def midround_yield(self, laser) -> None:
+        """svm hook, fired every `yield_every` processed states: open
+        states that already FINISHED the current round (accumulating in
+        laser.open_states while the round's worklist still executes)
+        migrate to idle ranks without waiting for the boundary."""
+        ctx = self._round
+        if ctx is None:
+            return
+        if (not self._split_eager
+                and len(laser.open_states) >= SPLIT_EAGER_FORKS):
+            self._split_eager = True  # first-round fork count refines
+            #                           the prior-seeded cost estimate
+        next_round, tx_count, address = ctx
+        if next_round >= tx_count or len(laser.open_states) < 2:
+            return
         if not self._pending_requests():
             return
-        states = laser.open_states
-        if len(states) < 2 or self.current_contract is None:
+        if self._export_wave(laser.open_states, next_round, tx_count,
+                             address):
+            self.stats["midround_exports"] += 1
+
+    def on_round_end(self, laser, next_round: int, tx_count: int,
+                     address) -> None:
+        """svm hook (laser/svm.py _execute_transactions): split the
+        round's surviving open states across drained thieves, in
+        place."""
+        if next_round >= tx_count:
+            return  # no rounds left: nothing worth migrating
+        if len(laser.open_states) < 2:
             return
+        if not self._pending_requests():
+            return
+        self._export_wave(laser.open_states, next_round, tx_count,
+                          address)
+
+    def _export_wave(self, states: List, next_round: int,
+                     tx_count: int, address) -> int:
+        """Multi-way export: split the wave's tail proportionally
+        across all idle ranks (k slices for k thieves, the victim
+        keeps at least an equal share), one claim-protocol offer per
+        slice. Trims `states` in place; returns offers published."""
+        if self.current_contract is None:
+            return 0
+        thieves = self._pending_requests()
+        n = len(states)
+        k = min(len(thieves), n - 1)
+        if k < 1:
+            return 0
+        share = n // (k + 1)
+        if share < 1:
+            return 0
         from ..smt import BitVec
         from ..support.checkpoint import save_checkpoint
 
-        half = states[len(states) // 2:]
-        self._offer_seq += 1
-        offer_id = f"{self.rank}_{self._offer_seq}"
-        batch = self.dir / f"offer_{offer_id}.batch"
+        addr = address.value if isinstance(address, BitVec) \
+            else address
         code_id = self._current_code_id
-        save_checkpoint(
-            str(batch), next_round, half,
-            address.value if isinstance(address, BitVec) else address,
-            code_id, include_modules=False)
-        if not batch.exists():  # save is best-effort; keep the states
-            return
-        del states[len(states) - len(half):]
-        meta = {
-            "contract": self.current_contract,
-            "code_id": code_id,
-            "tx_count": tx_count,
-            "round": next_round,
-            "victim": self.rank,
-        }
-        meta_path = self.dir / f"offer_{offer_id}.meta.json"
-        tmp = meta_path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(meta))
-        os.replace(tmp, meta_path)  # thieves glob for *.meta.json
-        self.outstanding[offer_id] = meta
-        log.info("rank %d: migrated %d open states (offer %s)",
-                 self.rank, len(half), offer_id)
+        ship = self._verdict_payload(states[n - k * share:]) \
+            if SHIP_VERDICTS else None
+        published = 0
+        for _ in range(k):
+            # always the current tail slice: the victim's own work
+            # continues from the head
+            chunk = states[len(states) - share:]
+            self._offer_seq += 1
+            offer_id = f"{self.rank}_{self._offer_seq}"
+            batch = self.dir / f"offer_{offer_id}.batch"
+            save_checkpoint(str(batch), next_round, chunk, addr,
+                            code_id, include_modules=False)
+            if not batch.exists():  # save is best-effort; keep states
+                continue
+            paths = [batch]
+            if ship:
+                side = self.dir / f"offer_{offer_id}.verdicts"
+                from ..support.checkpoint import save_verdict_sidecar
+
+                entries = self._entries_for(chunk, ship)
+                if entries and save_verdict_sidecar(side, entries):
+                    paths.append(side)
+            meta = {
+                "contract": self.current_contract,
+                "code_id": code_id,
+                "tx_count": tx_count,
+                "round": next_round,
+                "victim": self.rank,
+                "states": len(chunk),
+            }
+            meta_path = self.dir / f"offer_{offer_id}.meta.json"
+            tmp = meta_path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(meta))
+            os.replace(tmp, meta_path)  # thieves glob for *.meta.json
+            paths.append(meta_path)
+            # a live victim keeps its offer files fresh: the dead-
+            # thief clock must not start while the victim is still
+            # analyzing (see _collect)
+            if self._victim_hb is None:
+                self._victim_hb = _Heartbeat()
+                self._victim_hb.start()
+            self._victim_hb.add_paths(*paths)
+            self.outstanding[offer_id] = meta
+            # trim AFTER the successful save: an aborted offer must
+            # leave its states with the victim
+            del states[len(states) - share:]
+            self.stats["states_migrated"] += len(chunk)
+            self.stats["batches_out"] += 1
+            published += 1
+            log.info("rank %d: migrated %d open states (offer %s, "
+                     "%d thieves idle)", self.rank, len(chunk),
+                     offer_id, len(thieves))
+        return published
+
+    def _verdict_payload(self, states: List):
+        """Pre-export feasibility screen over the shipped slice: the
+        states' verdicts land in the run-wide cache (the victim pays
+        one warm-cache discharge it would otherwise pay at the next
+        round's prune) so the sidecars carry EXACT full-set proofs,
+        not just ancestor prefixes. Returns the cache, or None."""
+        try:
+            from ..smt.solver import verdicts as verdict_mod
+            from ..support.model import check_batch
+
+            vc = verdict_mod.cache()
+            if vc is None:
+                return None
+            check_batch([ws.constraints for ws in states])
+            return vc
+        except Exception as e:
+            log.debug("pre-export screen failed (%s); shipping "
+                      "prefix proofs only", e)
+            try:
+                from ..smt.solver import verdicts as verdict_mod
+
+                return verdict_mod.cache()
+            except Exception:
+                return None
+
+    @staticmethod
+    def _entries_for(chunk: List, vc) -> List:
+        """Cached proofs restricted to the chunk's constraint
+        prefixes, as picklable (terms, verdict, model) triples."""
+        try:
+            term_lists = []
+            for ws in chunk:
+                getter = getattr(ws.constraints, "get_all_constraints",
+                                 None)
+                cons = getter() if getter else list(ws.constraints)
+                term_lists.append(
+                    [c.raw for c in cons if type(c) != bool])
+            return vc.export_entries(term_lists)
+        except Exception as e:
+            log.debug("verdict export failed (%s)", e)
+            return []
 
     def begin_contract(self, contract_path: str, contract) -> None:
         self.current_contract = contract_path
         self._current_code_id = code_identity(contract)
+        self._split_eager = contract_path in self.splittable
+        self._round = None
 
     def finalize_contract(self, report) -> int:
         """Wait for every outstanding offer's result and merge its
@@ -168,6 +360,12 @@ class MigrationBus:
         as an unsplit run would). Unclaimed/failed offers are resumed
         locally. Returns the number of batches a REMOTE rank actually
         analyzed (local fallbacks are not migrations)."""
+        # the victim stops refreshing its offer files HERE: from this
+        # point the dead-thief clock in _collect runs against the
+        # thief's own claim heartbeat
+        if self._victim_hb is not None:
+            self._victim_hb.stop()
+            self._victim_hb = None
         merged = 0
         for offer_id, meta in list(self.outstanding.items()):
             issues, remote = self._collect(offer_id, meta)
@@ -177,6 +375,8 @@ class MigrationBus:
                 merged += 1
             del self.outstanding[offer_id]
         self.current_contract = None
+        self._round = None
+        self._split_eager = False
         return merged
 
     def _collect(self, offer_id: str,
@@ -184,6 +384,7 @@ class MigrationBus:
         result = self.dir / f"result_{offer_id}.pkl"
         failed = self.dir / f"failed_{offer_id}"
         claim = self.dir / f"claim_{offer_id}"
+        meta_path = self.dir / f"offer_{offer_id}.meta.json"
         while True:
             if result.exists():
                 try:
@@ -200,20 +401,25 @@ class MigrationBus:
                 # resume locally — two victims waiting on each other's
                 # offers must not deadlock. The claim keeps a late
                 # thief from duplicating the work.
-                if ((not self._pending_requests()
+                if ((not self._pending_requests(max_age=0.0)
                      or self.others_done())
                         and _claim(claim)):
                     break
             else:
-                # a live thief heartbeats the claim file every
-                # transaction round; only a STALE claim times out —
-                # a slow-but-alive thief is never raced with a
-                # duplicate local run
-                try:
-                    age = time.time() - claim.stat().st_mtime
-                except OSError:
-                    age = 0.0
-                if age > CLAIMED_WAIT_S:
+                # a live thief heartbeats the claim file; only a STALE
+                # claim times out. The clock measures from the FRESHEST
+                # of the claim and the offer meta: while the victim was
+                # still analyzing it heartbeated its own offer files,
+                # so a thief that claimed long before the victim got
+                # here is never raced with a duplicate local run just
+                # because the victim's analysis outlived the timeout.
+                age_ref = 0.0
+                for p in (claim, meta_path):
+                    try:
+                        age_ref = max(age_ref, p.stat().st_mtime)
+                    except OSError:
+                        pass
+                if time.time() - age_ref > CLAIMED_WAIT_S:
                     log.warning("offer %s claimed but never answered; "
                                 "re-running locally", offer_id)
                     break
@@ -230,6 +436,8 @@ class MigrationBus:
         """Drained rank: advertise, then claim and run migrated batches
         until every other rank is done. Returns batches served."""
         served = 0
+        t_request = time.perf_counter()
+        first_claim: Optional[float] = None
         self.request_work()
         try:
             while True:
@@ -244,9 +452,14 @@ class MigrationBus:
                         continue
                     if not _claim(self.dir / f"claim_{offer_id}"):
                         continue
+                    if first_claim is None:
+                        first_claim = time.perf_counter() - t_request
+                        self.stats["steal_latency_s"] = round(
+                            first_claim, 3)
                     took = True
                     if self._run_offer(offer_id, meta_path):
                         served += 1
+                        self.stats["batches_in"] += 1
                 if not took:
                     if self.others_done():
                         return served
@@ -263,7 +476,9 @@ class MigrationBus:
                 issues = analyze_batch(
                     meta, self.dir / f"offer_{offer_id}.batch",
                     self.timeout, self.tpu_lanes,
-                    work_tag=f"thief{self.rank}")
+                    work_tag=f"thief{self.rank}",
+                    verdicts_path=self.dir
+                    / f"offer_{offer_id}.verdicts")
             _dump_issues(self.dir / f"result_{offer_id}.pkl", issues)
             log.info("rank %d: served migrated batch %s (%d issues)",
                      self.rank, offer_id, len(issues))
@@ -274,50 +489,76 @@ class MigrationBus:
             return False
 
 
-import threading
-
-
 class _Heartbeat:
-    """Background toucher: keeps a claim/request file's mtime fresh
-    while its owner is alive, so staleness checks can tell a slow
-    worker from a dead one at any analysis length."""
+    """Background toucher: keeps claim/request/offer files' mtimes
+    fresh while their owner is alive, so staleness checks can tell a
+    slow worker from a dead one at any analysis length. Paths may be
+    added while running (the victim's offer set grows per export)."""
 
     PERIOD_S = 5.0
 
     def __init__(self, *paths: Path):
-        self._paths = paths
+        self._paths = list(paths)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
+    def add_paths(self, *paths: Path) -> None:
+        self._paths.extend(paths)
+
     def _run(self):
         while not self._stop.wait(self.PERIOD_S):
-            for p in self._paths:
+            for p in list(self._paths):
                 try:
                     os.utime(p)
                 except OSError:
                     pass
 
-    def __enter__(self):
+    def start(self) -> "_Heartbeat":
         self._thread.start()
         return self
 
-    def __exit__(self, *exc):
+    def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=2)
 
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
 
 def analyze_batch(meta: dict, batch_path, timeout: int,
-                  tpu_lanes: int, work_tag: str = "local") -> List:
+                  tpu_lanes: int, work_tag: str = "local",
+                  verdicts_path=None) -> List:
     """Resume a migrated batch through the ordinary checkpoint
     machinery: same contract, remaining transaction rounds, this
     rank's own engine + full detector set; returns Issue objects.
     The batch is COPIED to a private work file first — the resuming
     engine's checkpoint sink writes its own progress there, and the
-    shared offer file must stay immutable for fallback."""
+    shared offer file must stay immutable for fallback. A verdict
+    sidecar, when present, replays the victim's cached proofs into
+    this process's run-wide verdict cache before the resume (the
+    terms re-intern locally, so the fingerprints re-derive here)."""
     from ..orchestration.mythril_analyzer import MythrilAnalyzer
     from ..orchestration.mythril_disassembler import MythrilDisassembler
     from ..support.analysis_args import make_cmd_args
     from ..support.checkpoint import RESUME_STATS
+
+    if verdicts_path is not None:
+        try:
+            from ..smt.solver import verdicts as verdict_mod
+            from ..support.checkpoint import load_verdict_sidecar
+
+            vc = verdict_mod.cache()
+            entries = load_verdict_sidecar(verdicts_path) \
+                if vc is not None else []
+            if entries:
+                n = vc.import_entries(entries)
+                log.info("replayed %d shipped verdicts for batch %s",
+                         n, Path(batch_path).name)
+        except Exception as e:
+            log.debug("verdict replay failed (%s); re-proving", e)
 
     batch_path = Path(batch_path)
     work = batch_path.with_name(

@@ -242,11 +242,14 @@ class VerdictCache:
             return None
         return True
 
-    def probe(self, terms: Sequence, tids: Optional[tuple] = None):
+    def probe(self, terms: Sequence, tids: Optional[tuple] = None,
+              shadow: bool = True):
         """(verdict | None, ModelData | None) for a raw-term conjunction.
 
         Tier order: exact-key hit, ancestor-UNSAT subsumption, host
-        parent-model shadow. Counts land in SolverStatistics."""
+        parent-model shadow (skipped with ``shadow=False`` — the
+        pruner's pre-screen kill pass wants only O(lookup) tiers).
+        Counts land in SolverStatistics."""
         if not ENABLED or not terms:
             return None, None
         if tids is None:
@@ -264,6 +267,8 @@ class VerdictCache:
             # already covers every further descendant)
             self.record(tids, UNSAT, index_unsat=False)
             return UNSAT, None
+        if not shadow:
+            return None, None
         sp = self._shadow_parent(tids)
         if sp is not None:
             model, delta = sp
@@ -366,6 +371,73 @@ class VerdictCache:
         self._ensure_entry(ks).bounds = bounds
         return bounds
 
+    # -- migration shipping (parallel/migrate.py) --------------------------
+
+    def export_entries(self, term_lists: Sequence[Sequence]) -> List:
+        """Cached proofs restricted to the given states' constraint
+        prefixes, as ``(ordered terms, verdict, model)`` triples ready
+        for term-safe pickling (support/checkpoint.py sidecars).
+
+        For each normalized raw-term list this collects the exact-key
+        entry, every cached ordered-prefix entry (both discharge
+        shapes: plain ``tids[:j]`` and the axiom-tailed ``tids[:j] +
+        (tids[-1],)``), and every indexed UNSAT set subsumed by the
+        state's tid-set. Terms ship as objects — the thief re-interns
+        them into its own table, so the fingerprints re-derive there
+        (tids are process-local). Models ship as slim copies (the
+        eval memos and env caches stay home)."""
+        out: Dict[frozenset, tuple] = {}
+        for terms in term_lists:
+            terms = list(terms)
+            if not terms:
+                continue
+            tids = tuple(t.tid for t in terms)
+            by_tid = {t.tid: t for t in terms}
+            n = len(tids)
+            cands = []
+            for j in range(1, n + 1):
+                cands.append(tids[:j])
+                if j < n:
+                    cands.append(tids[:j] + (tids[-1],))
+            for ptids in cands:
+                pk = self._fp.get(ptids)
+                if pk is None or pk in out:
+                    continue
+                e = self._entries.get(pk)
+                if e is None or e.verdict not in (SAT, UNSAT):
+                    continue
+                seen = set()
+                ordered = [by_tid[t] for t in ptids
+                           if t in pk and not (t in seen or seen.add(t))]
+                out[pk] = (ordered, e.verdict, _slim_model(e.model))
+            ks = frozenset(tids)
+            for t in ks:
+                for u in self._unsat_by_rep.get(t, ()):
+                    if u not in out and u <= ks:
+                        out[u] = ([by_tid[x] for x in sorted(u)],
+                                  UNSAT, None)
+        entries = list(out.values())
+        SolverStatistics().verdicts_shipped += len(entries)
+        return entries
+
+    def import_entries(self, entries: Sequence) -> int:
+        """Record shipped proofs under THIS process's term table (the
+        terms re-interned on load carry this table's tids). Returns the
+        number of entries replayed; counted in verdicts_replayed."""
+        if not ENABLED:
+            return 0
+        n = 0
+        for terms, verdict, model in entries:
+            try:
+                self.record(tuple(t.tid for t in terms), verdict,
+                            model=model)
+                n += 1
+            except Exception:  # a cache, never an error path
+                log.debug("verdict import skipped one entry",
+                          exc_info=True)
+        SolverStatistics().verdicts_replayed += n
+        return n
+
     def interval_unsat(self, assertions: Sequence) -> bool:
         """state_infeasible with inherited bound seeds; a refutation is
         a sound proof and is recorded for ancestor subsumption."""
@@ -379,6 +451,12 @@ class VerdictCache:
         e = self._entries.get(ks)
         if e is not None and e.verdict is not None:
             return e.verdict == UNSAT
+        if self.ancestor_unsat(ks):
+            # a shipped or prior-window UNSAT prefix subsumes this set
+            # (migration sidecars land here on the thief)
+            SolverStatistics().verdict_unsat_kills += 1
+            self.record(tids, UNSAT, index_unsat=False)
+            return True
         bounds = self.bounds_for(raws, tids)
         memo: Dict[int, object] = {}
         for var, lo, hi in bounds.values():
@@ -390,6 +468,23 @@ class VerdictCache:
             self.record(tids, UNSAT)
             return True
         return False
+
+
+def _slim_model(model):
+    """Copy of a ModelData holding only the assignment dicts: the
+    per-model eval memos / env caches can pin hundreds of MB and mean
+    nothing on another rank."""
+    if model is None:
+        return None
+    try:
+        slim = core.ModelData()
+        slim.bv = dict(model.bv)
+        slim.bools = dict(model.bools)
+        slim.arrays = dict(model.arrays)
+        slim.funcs = dict(model.funcs)
+        return slim
+    except Exception:
+        return None
 
 
 _CACHE = VerdictCache()

@@ -181,6 +181,42 @@ def load_with_terms(stream):
         _LOAD_TERMS = {}
 
 
+def save_verdict_sidecar(path, entries) -> bool:
+    """Atomically write a migration batch's verdict-cache sidecar:
+    ``(ordered terms, verdict, model)`` triples from
+    VerdictCache.export_entries, term-safe pickled (the terms travel as
+    flat-table rows and re-intern on the thief — fingerprints are
+    process-local tids and must re-derive there). Best-effort: a
+    sidecar failure must never block the batch it rides with."""
+    try:
+        path = str(path)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)) or ".",
+            prefix=".vsc-")
+        with os.fdopen(fd, "wb") as f:
+            dump_with_terms(f, list(entries))
+        os.replace(tmp, path)
+        return True
+    except Exception as e:
+        log.warning("verdict sidecar save failed (%s); batch ships "
+                    "without cached proofs", e)
+        return False
+
+
+def load_verdict_sidecar(path) -> list:
+    """Inverse of save_verdict_sidecar; absent/corrupt sidecars load as
+    empty (the thief just re-proves — degraded, never wrong)."""
+    try:
+        if not os.path.exists(str(path)):
+            return []
+        with open(str(path), "rb") as f:
+            return list(load_with_terms(f))
+    except Exception as e:
+        log.warning("verdict sidecar load failed (%s); replaying "
+                    "nothing", e)
+        return []
+
+
 def save_checkpoint(path: str, round_index: int, open_states,
                     target_address: int, code_id: str,
                     include_modules: bool = True) -> None:

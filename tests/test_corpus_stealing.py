@@ -96,3 +96,53 @@ def test_stealing_balances_imbalanced_corpus(tmp_path):
     static_makespan = max(s["wall_s"] for s in static["shards"])
     steal_makespan = max(s["wall_s"] for s in stolen["shards"])
     assert steal_makespan < static_makespan * 1.25
+
+
+def test_stats_persist_and_lpt_warm_start(tmp_path):
+    """A corpus run persists per-contract walls + fork peaks into
+    --out-dir/stats.json; the next run over the same dir schedules
+    cost-aware LPT from them and pre-declares the long pole splittable
+    (parallel/cost_model.py, docs/work_stealing.md)."""
+    from mythril_tpu.parallel import cost_model as cm
+    from mythril_tpu.parallel.corpus import run_corpus
+
+    def fake(path):
+        name = Path(path).name
+        heavy = "heavy" in name
+        return {"contract": name, "issues": 0, "swc": [],
+                "wall_s": 10.0 if heavy else 1.0,
+                "fork_peak": 300 if heavy else 0}
+
+    files = []
+    for n in ("a_heavy.sol.o", "b_light.sol.o", "c_light.sol.o",
+              "d_light.sol.o"):
+        f = tmp_path / n
+        f.write_text("00")
+        files.append(str(f))
+    out = tmp_path / "out"
+    run_corpus(files, str(out), 0, 1, analyze=fake, steal=False)
+
+    stats = cm.load_stats(out)
+    assert stats["a_heavy.sol.o"]["wall_s"] == 10.0
+    assert stats["a_heavy.sol.o"]["fork_peak"] == 300
+    assert stats["b_light.sol.o"]["wall_s"] == 1.0
+
+    # the warm-started schedule isolates the long pole on its own
+    # rank and declares it splittable (cost above total/n_ranks)
+    shards, split = cm.make_shards(files, 2, stats)
+    heavy_shards = [s for s in shards
+                    if any("heavy" in p for p in s)]
+    assert len(heavy_shards) == 1 and len(heavy_shards[0]) == 1
+    assert split == {files[0]}
+
+    # a second run EMA-merges new walls and keeps the fork-peak max
+    def fake2(path):
+        r = fake(path)
+        if "heavy" in r["contract"]:
+            r["wall_s"], r["fork_peak"] = 20.0, 120
+        return r
+
+    run_corpus(files, str(out), 0, 1, analyze=fake2, steal=False)
+    stats = cm.load_stats(out)
+    assert stats["a_heavy.sol.o"]["wall_s"] == pytest.approx(15.0)
+    assert stats["a_heavy.sol.o"]["fork_peak"] == 300

@@ -1,8 +1,10 @@
-"""Cross-host PATH-BATCH migration (SURVEY §2.10 distributed-backend
-row): a rigged two-rank corpus where rank 1 drains instantly and rank 0
-analyzes a heavy contract whose round-1 boundary has 4 open states —
-half of them must migrate to rank 1 mid-analysis, with the merged
-report identical to a no-migration run."""
+"""Cost-aware intra-contract work sharding (parallel/migrate.py,
+docs/work_stealing.md): rigged multi-rank corpora where drained ranks
+take slices of a heavy contract's open-state wave mid-analysis — at a
+round boundary, MID-ROUND, and split multi-way across three thieves —
+always with the merged report identical to a no-migration run. Plus
+in-process units for the dead-thief local-resume fallback under
+multi-way offers and the verdict-cache sidecar round trip."""
 
 import json
 import os
@@ -10,6 +12,7 @@ import shutil
 import socket
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -19,21 +22,23 @@ from .fixture_paths import INPUTS
 HEAVY, LIGHT = "ether_send.sol.o", "nonascii.sol.o"
 
 
-def _corpus(tmp_path):
-    a = tmp_path / f"a_{HEAVY}"
-    b = tmp_path / f"b_{LIGHT}"
-    shutil.copy(INPUTS / HEAVY, a)
-    shutil.copy(INPUTS / LIGHT, b)
-    return [str(a), str(b)]
+def _corpus(tmp_path, n_light=1):
+    files = [tmp_path / f"a_{HEAVY}"]
+    shutil.copy(INPUTS / HEAVY, files[0])
+    for i, tag in zip(range(n_light), "bcdefg"):
+        dst = tmp_path / f"{tag}_{LIGHT}"
+        shutil.copy(INPUTS / LIGHT, dst)
+        files.append(dst)
+    return [str(f) for f in files]
 
 
-def _run(tmp_path, files, out_name, migrate):
+def _run(tmp_path, files, out_name, migrate, ranks=2, extra_env=None):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     out_dir = tmp_path / out_name
     procs = []
-    for rank in range(2):
+    for rank in range(ranks):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
@@ -41,9 +46,11 @@ def _run(tmp_path, files, out_name, migrate):
         # thief to be polling when round 1 ends, regardless of
         # process-startup skew on the shared single CPU
         env["MTPU_ANALYZE_DELAY"] = "ether_send=8,nonascii=0.1"
+        env.update(extra_env or {})
         cmd = [sys.executable, "-m", "mythril_tpu.parallel.corpus",
                "--coordinator", f"127.0.0.1:{port}",
-               "--num-processes", "2", "--process-id", str(rank),
+               "--num-processes", str(ranks),
+               "--process-id", str(rank),
                "--out-dir", str(out_dir), "--timeout", "90"]
         if migrate:
             cmd.append("--migrate")
@@ -81,3 +88,174 @@ def test_midflight_batch_migrates_with_identical_report(tmp_path):
                  for s in moved["shards"])
     assert out >= 1, moved["shards"]
     assert served >= 1, moved["shards"]
+    # shipped verdict-cache entries landed on the thief and registered
+    # as solver reuse (never as wrong verdicts: the canon equality
+    # above IS the parity check)
+    thieves = [s for s in moved["shards"]
+               if s["migration"].get("batches_in", 0) > 0]
+    assert sum(s["solver"].get("verdicts_replayed", 0)
+               for s in thieves) > 0, moved["shards"]
+    assert all(s["solver"].get("queries_saved", 0) > 0
+               for s in thieves), moved["shards"]
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+def test_midround_steal_parity(tmp_path):
+    """The wave sheds WHILE a round is still executing (the mid-round
+    yield in laser/svm.py): per-path delay keeps the victim's round 1
+    running long after the thief drained, the poll period is tightened,
+    and the merged report must STILL match the no-migration run."""
+    files = _corpus(tmp_path)
+    rig = {"MTPU_PATH_DELAY": "0.5", "MTPU_MIDROUND_K": "64"}
+
+    plain = _run(tmp_path, files, "plain", migrate=False,
+                 extra_env=rig)
+    moved = _run(tmp_path, files, "midround", migrate=True,
+                 extra_env=rig)
+
+    assert _canon(plain) == _canon(moved), (
+        f"plain: {_canon(plain)}\nmigrated: {_canon(moved)}")
+    assert plain["errors"] == 0 and moved["errors"] == 0
+    # at least one export wave fired MID-ROUND (not only at the
+    # round boundary), and its batches were served remotely
+    assert moved.get("midround_exports", 0) >= 1, moved["shards"]
+    assert moved.get("batches_in", 0) >= 1, moved["shards"]
+
+
+@pytest.mark.skipif(not INPUTS.exists(), reason="fixtures not present")
+def test_multiway_split_three_thieves(tmp_path):
+    """A 4-rank corpus with one long pole: the victim's wave must split
+    across the idle ranks as MULTIPLE offers (k slices for k thieves,
+    not one half to one thief), with the merged report unchanged."""
+    files = _corpus(tmp_path, n_light=3)
+
+    plain = _run(tmp_path, files, "plain4", migrate=False, ranks=4)
+    moved = _run(tmp_path, files, "multiway", migrate=True, ranks=4)
+
+    assert _canon(plain) == _canon(moved), (
+        f"plain: {_canon(plain)}\nmigrated: {_canon(moved)}")
+    assert plain["errors"] == 0 and moved["errors"] == 0
+    # the round-1 wave (4 open states) split into MULTIPLE offers in
+    # one export (victim keeps one share), and remote ranks served them
+    assert moved.get("batches_out", 0) >= 2, moved["shards"]
+    assert moved.get("batches_in", 0) >= 2, moved["shards"]
+
+
+def _touch_old(path, age_s):
+    past = time.time() - age_s
+    os.utime(path, (past, past))
+
+
+def test_dead_thief_fallback_multiway(tmp_path, monkeypatch):
+    """Multi-way offers generalize the dead-thief fallback: every
+    offer whose claim goes stale (or that nobody claims while no thief
+    is asking) resumes LOCALLY through analyze_batch — work can
+    migrate, but never be lost."""
+    from mythril_tpu.parallel import migrate
+
+    monkeypatch.setattr(migrate, "CLAIMED_WAIT_S", 0.5)
+    bus = migrate.MigrationBus(str(tmp_path), rank=0, num_ranks=3)
+    resumed = []
+    monkeypatch.setattr(
+        migrate, "analyze_batch",
+        lambda meta, batch, timeout, lanes, work_tag="local",
+        verdicts_path=None: resumed.append(meta["id"]) or
+        [f"issue_{meta['id']}"])
+
+    # three outstanding offers: one claimed by a thief that died
+    # (stale claim, no result), one claimed-and-answered, one never
+    # claimed with no thief asking
+    for i, state in enumerate(("dead", "answered", "unclaimed")):
+        offer_id = f"0_{i}"
+        meta = {"id": i, "contract": "x", "code_id": "c",
+                "tx_count": 2, "round": 1, "victim": 0}
+        (bus.dir / f"offer_{offer_id}.batch").write_bytes(b"")
+        (bus.dir / f"offer_{offer_id}.meta.json").write_text(
+            json.dumps(meta))
+        bus.outstanding[offer_id] = meta
+        if state == "dead":
+            claim = bus.dir / f"claim_{offer_id}"
+            claim.touch()
+            _touch_old(claim, 30)
+            _touch_old(bus.dir / f"offer_{offer_id}.meta.json", 30)
+        elif state == "answered":
+            (bus.dir / f"claim_{offer_id}").touch()
+            migrate._dump_issues(
+                bus.dir / f"result_{offer_id}.pkl", ["remote_issue"])
+    # the other ranks are done: no thief is asking anymore
+    (bus.dir / "done_1").touch()
+    (bus.dir / "done_2").touch()
+
+    merged_issues = []
+    report = type("R", (), {"append_issue":
+                            lambda self, i: merged_issues.append(i)})()
+    bus.current_contract = "x"
+    remote = bus.finalize_contract(report)
+
+    # exactly the dead-claim and unclaimed offers re-ran locally;
+    # the answered one merged its remote result
+    assert sorted(resumed) == [0, 2], resumed
+    assert remote == 1
+    assert set(merged_issues) == {"issue_0", "issue_2", "remote_issue"}
+    assert not bus.outstanding
+
+
+def test_verdict_sidecar_roundtrip(tmp_path):
+    """Cached proofs survive the export -> sidecar -> import round
+    trip and register as solver reuse (queries_saved) when the
+    imported cache answers the same constraint sets."""
+    from mythril_tpu.laser.state.constraints import Constraints
+    from mythril_tpu.smt import ULE, ULT, symbol_factory
+    from mythril_tpu.smt.solver import verdicts
+    from mythril_tpu.smt.solver.solver_statistics import (
+        SolverStatistics,
+    )
+    from mythril_tpu.support.checkpoint import (
+        load_verdict_sidecar,
+        save_verdict_sidecar,
+    )
+    from mythril_tpu.support.model import check_batch
+
+    verdicts.reset_cache()
+    verdicts.ENABLED = True
+    bv = lambda v: symbol_factory.BitVecVal(v, 256)  # noqa: E731
+    x = symbol_factory.BitVecSym("sidecar_x", 256)
+    y = symbol_factory.BitVecSym("sidecar_y", 256)
+    sat_set = Constraints([ULE(bv(5), x), ULE(x, bv(100)),
+                           ULE(y, x)])
+    unsat_set = Constraints([ULT(x, bv(4)), ULE(bv(9), x)])
+    check_batch([sat_set, unsat_set])  # populate the victim's cache
+
+    vc = verdicts.cache()
+    # same shape migrate._entries_for ships: the full discharge-time
+    # constraint lists (incl. the keccak-axiom tail)
+    term_lists = [[c.raw for c in s.get_all_constraints()]
+                  for s in (sat_set, unsat_set)]
+    entries = vc.export_entries(term_lists)
+    assert entries, "nothing exported"
+    side = tmp_path / "batch.verdicts"
+    assert save_verdict_sidecar(side, entries)
+
+    # fresh cache = the thief's process (same term table: tids
+    # re-derive identically after the sidecar's re-intern)
+    verdicts.reset_cache()
+    loaded = load_verdict_sidecar(side)
+    assert len(loaded) == len(entries)
+    thief = verdicts.cache()
+    ss = SolverStatistics()
+    replayed0 = ss.verdicts_replayed
+    saved0 = ss.batch_counters()["queries_saved"]
+    assert thief.import_entries(loaded) == len(loaded)
+    assert ss.verdicts_replayed - replayed0 == len(loaded)
+
+    # the imported proofs answer without any solver call
+    sat_verdict, model = thief.probe(
+        [c.raw for c in sat_set.get_all_constraints()])
+    unsat_verdict, _ = thief.probe(
+        [c.raw for c in unsat_set.get_all_constraints()])
+    assert sat_verdict == verdicts.SAT
+    assert unsat_verdict == verdicts.UNSAT
+    assert ss.batch_counters()["queries_saved"] > saved0
+    # and the shipped model is a usable assignment
+    assert model is not None
+    verdicts.reset_cache()
