@@ -340,6 +340,7 @@ def _analyze_fixture(path, timeout, tx_count, tpu_lanes):
         "solver_batch": {
             k: round(v - b0.get(k, 0), 1)
             for k, v in ss.batch_counters().items()
+            if isinstance(v, (int, float))  # races_won_by_tactic: dict
         },
     }
 
@@ -852,10 +853,114 @@ def _smoke_steal():
     }
 
 
+def _smoke_pool():
+    """Stage 5: the persistent-solver-pool gate (docs/solver_pool.md).
+
+    A rigged solver-heavy batch — an easy SAT/UNSAT mix plus a tail of
+    timeout-bound 64-bit factoring instances (x*y == 2^61-1, a
+    Mersenne prime, with trivial factors excluded: UNSAT in principle,
+    UNKNOWN at any sane budget under every tactic, so verdicts are
+    deterministic; 64-bit keeps the multiplier cheap to BLAST, so the
+    serial cost is timeout waiting, which parallelizes even on one
+    core, not GIL-bound encoding, which does not) — discharged twice
+    over the SAME term sets with the run-wide verdict cache disabled
+    and sessions reset in between:
+
+    1. serial (pool at K=1): today's single-context trie walk;
+    2. pooled (K=4, racing on, short first budget) through
+       `discharge_async`, with host-side work between submit and
+       collect so the async seam provably hides solver wall.
+
+    Gates (exit 1 on any miss): (a) pooled verdicts identical to
+    serial, (b) pooled wall <= serial wall — the hard tail burns its
+    timeout CONCURRENTLY across workers (wall-clock-bound, so this
+    holds even on one core), (c) nonzero portfolio_races and
+    async_overlap_ms counters."""
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.solver import batch as solver_batch
+    from mythril_tpu.smt.solver import pool as pool_mod
+    from mythril_tpu.smt.solver import verdicts as verdict_mod
+    from mythril_tpu.smt.solver.core import reset_session
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+
+    ss = SolverStatistics()
+    bv = lambda v: T.bv_const(v, 256)  # noqa: E731
+    bv64 = lambda v: T.bv_const(v, 64)  # noqa: E731
+    MERSENNE_61 = (1 << 61) - 1  # prime: x*y==p has no 3<=x,y<2^32
+    sets = []
+    for j in range(12):
+        x = T.bv_var(f"pool_smoke_x{j}", 256)
+        y = T.bv_var(f"pool_smoke_y{j}", 256)
+        sets.append([T.mk_ule(bv(16), x), T.mk_ule(x, bv(4096)),
+                     T.mk_ule(y, x)])
+        if j % 3 == 0:
+            sets.append([T.mk_ult(x, bv(4)), T.mk_ule(bv(9), x),
+                         T.mk_ule(y, bv(j))])
+    for j in range(4):
+        x = T.bv_var(f"pool_smoke_hx{j}", 64)
+        y = T.bv_var(f"pool_smoke_hy{j}", 64)
+        sets.append([
+            T.mk_eq(T.mk_mul(x, y), bv64(MERSENNE_61)),
+            T.mk_ule(bv64(3), x), T.mk_ule(bv64(3), y),
+            T.mk_ult(x, bv64(1 << 32)), T.mk_ult(y, bv64(1 << 32)),
+        ])
+    timeout_s = 0.9
+
+    old_enabled = verdict_mod.ENABLED
+    verdict_mod.ENABLED = False  # no cross-run reuse: both runs solve
+    try:
+        pool_mod.configure_pool(workers=1)
+        reset_session()
+        t0 = time.perf_counter()
+        serial = solver_batch.discharge(sets, timeout_s=timeout_s)
+        serial_wall = time.perf_counter() - t0
+
+        c0 = dict(ss.batch_counters())
+        pool_mod.configure_pool(workers=4, racing=True,
+                                first_timeout_s=0.15,
+                                first_conflicts=2048)
+        reset_session()
+        t0 = time.perf_counter()
+        fut = solver_batch.discharge_async(sets, timeout_s=timeout_s)
+        # host-side work the async seam hides solver wall behind (the
+        # lane engine's window pull / svm's checkpoint IO stand-in)
+        time.sleep(0.25)
+        pooled = fut.result()
+        pooled_wall = time.perf_counter() - t0
+        c1 = ss.batch_counters()
+    finally:
+        verdict_mod.ENABLED = old_enabled
+        pool_mod.configure_pool(workers=1)
+        reset_session()
+
+    races = c1["portfolio_races"] - c0.get("portfolio_races", 0)
+    overlap = round(c1["async_overlap_ms"]
+                    - c0.get("async_overlap_ms", 0), 1)
+    result = {
+        "queries": len(sets),
+        "verdicts_identical": pooled == serial,
+        "serial_wall_s": round(serial_wall, 2),
+        "pooled_wall_s": round(pooled_wall, 2),
+        "speedup": round(serial_wall / max(pooled_wall, 1e-9), 2),
+        "queries_pooled": c1["queries_pooled"]
+        - c0.get("queries_pooled", 0),
+        "portfolio_races": races,
+        "async_overlap_ms": overlap,
+        "race_wins": c1["races_won_by_tactic"],
+    }
+    result["ok"] = bool(
+        result["verdicts_identical"]
+        and pooled_wall <= serial_wall
+        and races > 0
+        and overlap > 0
+    )
+    return result
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Four stages:
+    run-wide verdict cache — NO full corpus sweep. Five stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -876,7 +981,14 @@ def bench_smoke():
        (_smoke_steal, docs/work_stealing.md): merged-report identity
        with the migration bus on vs off, at least one migrated batch,
        shipped verdicts registering as the thief's queries_saved, and
-       a max-rank wall within 1.5x the mean. Any miss exits 1.
+       a max-rank wall within 1.5x the mean. Any miss exits 1;
+    5. the persistent-solver-pool gate (_smoke_pool,
+       docs/solver_pool.md): pooled-vs-serial verdict identity on a
+       rigged solver-heavy batch, pooled wall <= serial wall at K=4,
+       and nonzero portfolio_races / async_overlap_ms. Any miss
+       exits 1. Stages 1-4 run BEFORE the pool stage with the pool at
+       its default (K=1 on small CI boxes), so `MTPU_SOLVER_WORKERS=1`
+       leaves their results byte-identical to the pre-pool build.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -999,9 +1111,22 @@ def bench_smoke():
     else:
         out["steal"] = {"skipped": True, "ok": True}
 
+    # stage 5: the persistent solver pool (pooled-vs-serial identity,
+    # wall gate, race/overlap counters; skippable for the quick inner
+    # loop via MTPU_SMOKE_POOL=0)
+    if os.environ.get("MTPU_SMOKE_POOL", "1") != "0":
+        try:
+            out["pool"] = _smoke_pool()
+        except Exception as e:
+            out["pool"] = {"ok": False, "error": type(e).__name__,
+                           "detail": str(e)[:200]}
+    else:
+        out["pool"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
+        if isinstance(v, (int, float))  # races_won_by_tactic is a dict
     }
     print(json.dumps(out), flush=True)
     ok = (out["solver_batch"]["subset_kills"] > 0
@@ -1014,7 +1139,10 @@ def bench_smoke():
           and mismatches == 0
           # the steal gate: identical reports, real migration, shipped
           # verdicts banked on the thief, balanced rank walls
-          and out["steal"].get("ok", False))
+          and out["steal"].get("ok", False)
+          # the pool gate: verdict identity, pooled wall <= serial,
+          # nonzero races and async overlap
+          and out["pool"].get("ok", False))
     return 0 if ok else 1
 
 

@@ -68,7 +68,16 @@ def main():
         help="lane-engine width (0 = host interpreter); corpus mode "
         "amortizes device init/trace/compile-cache over all contracts",
     )
+    parser.add_argument(
+        "--solver-workers", type=int, default=None,
+        help="persistent solver pool width (smt/solver/pool.py; "
+        "default $MTPU_SOLVER_WORKERS or min(4, cpu); 1 = serial)",
+    )
     cli = parser.parse_args()
+    if cli.solver_workers is not None:
+        from mythril_tpu.smt.solver.pool import configure_pool
+
+        configure_pool(workers=cli.solver_workers)
     timeout = cli.timeout
     fixtures = sorted(INPUTS.glob("*.sol.o"))
     if not fixtures:

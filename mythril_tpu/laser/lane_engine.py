@@ -2336,6 +2336,63 @@ class LaneEngine:
         return [lane for (lane, _), v in zip(queries, verdicts)
                 if v == solver_batch.UNSAT]
 
+    def _submit_fork_screen(self, queries, registry):
+        """Start the fork-feasibility screen for this window's touched
+        lanes. With the solver pool parallel (smt/solver/pool.py) the
+        batch goes through `discharge_async` right away at the drain —
+        the pool's workers solve it while this thread packs and
+        dispatches the next window and blocks in the device pull — and
+        the returned token is collected one boundary later
+        (_collect_fork_screen), booking the hidden wall as
+        async_overlap_ms. With the pool serial the token defers the
+        whole screen to collection time, which lands in the overlapped
+        phase exactly where the synchronous screen ran before — the
+        K=1 path is behavior-identical to PR 1-3."""
+        from ..smt.solver import pool as pool_mod
+
+        if not pool_mod.get_pool().parallel:
+            return (queries, registry, None)
+        from ..smt import Model
+        from ..smt.solver import batch as solver_batch
+        from ..support.model import model_cache
+
+        term_sets = [[c.raw for c in conds] for _, conds in queries]
+
+        def quick_sat(conj):
+            return model_cache.check_quick_sat(conj)
+
+        def on_sat_model(md):
+            model_cache.put(Model([md]), 1)
+
+        try:
+            fut = solver_batch.discharge_async(
+                term_sets, timeout_s=2.0, conflict_budget=16384,
+                quick_sat=quick_sat, on_sat_model=on_sat_model,
+                registry=registry)
+        except Exception as e:  # a screen, never an error path
+            log.debug("async fork screen submit failed: %s", e)
+            return (queries, registry, None)
+        return (queries, registry, fut)
+
+    def _collect_fork_screen(self, token):
+        """Verdicts for a screen started at the previous boundary;
+        returns the proved-UNSAT lanes for the next dispatch's kill
+        list (same protocol as the synchronous screen)."""
+        queries, registry, fut = token
+        if fut is None:
+            return self._screen_forks(queries, registry)
+        from ..smt.solver import batch as solver_batch
+
+        try:
+            verdicts = fut.result()
+        except Exception as e:  # a screen, never an error path
+            log.debug("async fork screen failed: %s", e)
+            return []
+        self.stats["overlap_solve_ms"] += int(fut.duration_ms)
+        self.stats["fork_screened"] += len(queries)
+        return [lane for (lane, _), v in zip(queries, verdicts)
+                if v == solver_batch.UNSAT]
+
     # -- top-level loop ------------------------------------------------------
 
     def explore(self, code_bytes: bytes,
@@ -2439,6 +2496,7 @@ class LaneEngine:
 
             screen_registry = SubsetRegistry()
         pending_screen: List[tuple] = []
+        screen_future = None
         screen_dead: List[int] = []
         t_idle0 = None
         try:
@@ -2506,10 +2564,15 @@ class LaneEngine:
                 # GlobalStates and discharge its fork-feasibility batch
                 t_busy0 = time.perf_counter()
                 _flush_pending()
-                if pending_screen:
-                    screen_dead = self._screen_forks(pending_screen,
-                                                     screen_registry)
-                    pending_screen = []
+                if screen_future is not None:
+                    # started at the previous drain: with the pool
+                    # parallel the verdicts are usually already done
+                    # (they solved behind the pull + this dispatch);
+                    # serial tokens run the whole screen here, exactly
+                    # where the synchronous screen used to
+                    screen_dead = self._collect_fork_screen(
+                        screen_future)
+                    screen_future = None
                 busy_ms = (time.perf_counter() - t_busy0) * 1000
                 self.stats["overlap_busy_ms"] += int(busy_ms)
                 _solver_stats.overlap_busy_ms += busy_ms
@@ -2806,6 +2869,15 @@ class LaneEngine:
                             and ctxs[lane] is not None
                             and ctxs[lane].conds)
                     ][:256]
+                    if pending_screen:
+                        # submit NOW: a parallel pool solves while this
+                        # thread packs/dispatches the next window and
+                        # waits on the device pull (collected at the
+                        # next overlapped phase — kills still land at
+                        # dispatch k+2, same protocol as before)
+                        screen_future = self._submit_fork_screen(
+                            pending_screen, screen_registry)
+                        pending_screen = []
 
                 # width-demand sample: lanes concurrently occupied plus
                 # entries still queued for a slot (what a wide-enough

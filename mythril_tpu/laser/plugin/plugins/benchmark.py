@@ -100,6 +100,24 @@ class BenchmarkPlugin(LaserPlugin):
                 counters["verdict_bound_seeds"],
                 counters["queries_saved"],
             )
+            # persistent solver pool (docs/solver_pool.md): worker
+            # count, pooled queries, portfolio races (and which tactic
+            # won them), affinity hits, deaths, and the solver wall
+            # hidden behind device/host work by the async futures
+            if counters["pool_workers"] > 1 or \
+                    counters["queries_pooled"]:
+                log.info(
+                    "Solver pool: workers=%d pooled=%d races=%d "
+                    "race_wins=%s affinity_hits=%d deaths=%d "
+                    "async_overlap_ms=%s",
+                    counters["pool_workers"],
+                    counters["queries_pooled"],
+                    counters["portfolio_races"],
+                    counters["races_won_by_tactic"],
+                    counters["affinity_prefix_hits"],
+                    counters["worker_deaths"],
+                    counters["async_overlap_ms"],
+                )
             # migration-bus verdict shipping (docs/work_stealing.md):
             # proofs exported with stolen batches / replayed from a
             # victim's sidecar before a resume

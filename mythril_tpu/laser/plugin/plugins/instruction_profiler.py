@@ -90,6 +90,21 @@ class InstructionProfiler(LaserPlugin):
                     counters["verdict_bound_seeds"],
                     counters["queries_saved"],
                 ))
+            # persistent solver pool (docs/solver_pool.md)
+            if counters["pool_workers"] > 1 or \
+                    counters["queries_pooled"]:
+                lines.append(
+                    "Solver pool: workers={} pooled={} races={} "
+                    "race_wins={} affinity_hits={} deaths={} "
+                    "async_overlap_ms={}".format(
+                        counters["pool_workers"],
+                        counters["queries_pooled"],
+                        counters["portfolio_races"],
+                        counters["races_won_by_tactic"],
+                        counters["affinity_prefix_hits"],
+                        counters["worker_deaths"],
+                        counters["async_overlap_ms"],
+                    ))
             # migration-bus verdict shipping (docs/work_stealing.md)
             if counters["verdicts_shipped"] or \
                     counters["verdicts_replayed"]:

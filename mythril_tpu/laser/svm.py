@@ -12,6 +12,7 @@ mythril_tpu/models/pruner.py)."""
 import logging
 import os
 import random
+import sys
 import time
 from abc import ABCMeta
 from collections import defaultdict
@@ -279,6 +280,12 @@ class LaserEVM:
             execute_message_call(self, address, func_hashes=func_hashes)
             for hook in self._stop_sym_trans_hooks:
                 hook()
+            if (self.use_reachability_check
+                    and i + 1 < self.transaction_count):
+                # fully-async feasibility seam: round i+1's open-state
+                # screen starts NOW and is collected at the round top
+                # (no-op when the solver pool is serial)
+                self._screen_prefetch = self._submit_open_state_screen()
             if self.checkpoint_sink is not None:
                 self.checkpoint_sink(i + 1, self.open_states, address)
             # cross-host path-batch migration (parallel/migrate.py):
@@ -291,8 +298,48 @@ class LaserEVM:
         self.start_round = 0  # a later sym_exec must not skip rounds
         self.executed_transactions = True
 
+    def _submit_open_state_screen(self):
+        """Round-boundary async reachability prefetch
+        (docs/solver_pool.md): with the solver pool parallel the next
+        round's open-state screen is submitted as soon as this round's
+        states are final (right after the stop-transaction hooks), so
+        its solver wall runs behind the checkpoint sink, the migration
+        bus round-end and the per-round bookkeeping instead of
+        serializing in front of the next round. Returns None when the
+        pool is serial — the screen then runs synchronously at the
+        round top, exactly as before."""
+        from ..smt.solver import pool as pool_mod
+
+        if not self.open_states or not pool_mod.get_pool().parallel:
+            return None
+        snapshot = list(self.open_states)
+        return (snapshot,
+                pool_mod.get_pool().submit_async(
+                    lambda: self._screen_open_states(snapshot)))
+
     def _prune_unreachable_states(self, open_states):
-        """Reachability filter over open states. With the TPU pre-filter
+        """Reachability filter over open states (the screen itself is
+        _screen_open_states; a round-boundary prefetch may have already
+        run it — its verdicts are used only when the state list is
+        unchanged, element-identical, since the submit)."""
+        prefetch = getattr(self, "_screen_prefetch", None)
+        self._screen_prefetch = None
+        if prefetch is not None:
+            snapshot, fut = prefetch
+            if len(snapshot) == len(open_states) and all(
+                    a is b for a, b in zip(snapshot, open_states)):
+                try:
+                    return fut.result()
+                except Exception as e:
+                    log.debug("async open-state screen failed: %s", e)
+            # list changed since submit (e.g. the migration bus took a
+            # slice): redo synchronously — the background run banked
+            # its proofs in the verdict cache, so the redo is mostly
+            # exact-key hits
+        return self._screen_open_states(open_states)
+
+    def _screen_open_states(self, open_states):
+        """The reachability screen body. With the TPU pre-filter
         enabled, interval-infeasible states are dropped in batch before any
         solver query."""
         if args.tpu_prefilter:
@@ -667,8 +714,12 @@ class LaserEVM:
                 # the engagement gate (lane_engine.device_break_even)
                 # flips for a demonstrably wide-forking code on the
                 # next in-process analysis, even though the pruner
-                # idled the sweep for this one
-                if args.tpu_lanes and len(new_states) > 1:
+                # idled the sweep for this one. NOT gated on tpu_lanes:
+                # host-only corpus runs must persist real fork peaks to
+                # stats.json too (cost_model.HOST_PEAKS), or the next
+                # run's pick_width/LPT warm start sees fork_peak: 0
+                # (ROADMAP open item)
+                if len(new_states) > 1:
                     code_obj = global_state.environment.code
                     peaks = getattr(self, "_fork_peaks", None)
                     if peaks is None:
@@ -769,14 +820,26 @@ class LaserEVM:
 
     @staticmethod
     def _record_fork_scale(code_obj, peak: int) -> None:
-        """Feed the host worklist peak into the lane engine's per-code
-        fork-scale history (best-effort)."""
+        """Feed the host worklist peak into the per-code fork-scale
+        histories (best-effort): always into the cost model's host
+        table (parallel/cost_model.HOST_PEAKS — what stats.json
+        persists on host-only corpus runs), and into the lane engine's
+        PATH_HISTORY only when the lane path is already loaded — a
+        host-only run must not pay the jax/lane_engine import just to
+        record a peak."""
         try:
-            from .lane_engine import PATH_HISTORY, code_to_bytes
+            from ..parallel.cost_model import record_host_peak
 
-            code = code_to_bytes(code_obj)
-            if code and peak > PATH_HISTORY.get(code, 0):
-                PATH_HISTORY[code] = peak
+            record_host_peak(code_obj, peak)
+        except Exception:
+            pass
+        le = sys.modules.get("mythril_tpu.laser.lane_engine")
+        if le is None:
+            return
+        try:
+            code = le.code_to_bytes(code_obj)
+            if code and peak > le.PATH_HISTORY.get(code, 0):
+                le.PATH_HISTORY[code] = peak
         except Exception:
             pass
 

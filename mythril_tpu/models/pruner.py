@@ -9,12 +9,25 @@ are linearized and evaluated vectorized on device
 """
 
 import logging
+import threading
 from typing import List
 
 from ..smt.interval import state_infeasible
 from ..support.support_args import args
 
 log = logging.getLogger(__name__)
+
+#: guards STATS and the device-backoff globals: the round-boundary
+#: async open-state screen (laser/svm.py + smt/solver/pool.py) runs
+#: this module from an orchestration thread concurrently with the
+#: main thread's fork pruning, and unguarded `+=` would drop counts
+_stats_lock = threading.Lock()
+
+
+def _stat_add(**deltas) -> None:
+    with _stats_lock:
+        for k, v in deltas.items():
+            STATS[k] += v
 
 
 def _all_constraints(constraints):
@@ -77,16 +90,18 @@ STATS = {"screened": 0, "pruned": 0, "device_screened": 0}
 
 def _device_should_try() -> bool:
     global _device_skip
-    if _device_skip > 0:
-        _device_skip -= 1
-        return False
-    return True
+    with _stats_lock:
+        if _device_skip > 0:
+            _device_skip -= 1
+            return False
+        return True
 
 
 def _device_failed(e: Exception) -> None:
     global _device_failures, _device_skip
-    _device_failures += 1
-    _device_skip = min(2 ** _device_failures, _MAX_SKIP)
+    with _stats_lock:
+        _device_failures += 1
+        _device_skip = min(2 ** _device_failures, _MAX_SKIP)
     log.warning(
         "device interval screening failed (%s); falling back to host "
         "screening, retrying the device in %d calls", e, _device_skip,
@@ -95,7 +110,8 @@ def _device_failed(e: Exception) -> None:
 
 def _device_succeeded() -> None:
     global _device_failures
-    _device_failures = 0
+    with _stats_lock:
+        _device_failures = 0
 
 
 def _verdict_kills(open_states: List) -> List:
@@ -134,8 +150,8 @@ def prefilter_world_states(open_states: List) -> List:
 
     kept = _verdict_kills(open_states)
     if len(kept) < len(open_states):
-        STATS["screened"] += len(open_states) - len(kept)
-        STATS["pruned"] += len(open_states) - len(kept)
+        _stat_add(screened=len(open_states) - len(kept),
+                  pruned=len(open_states) - len(kept))
         log.info("verdict-cache pre-pass dropped %d open states",
                  len(open_states) - len(kept))
     open_states = kept
@@ -147,9 +163,9 @@ def prefilter_world_states(open_states: List) -> List:
         try:
             out = _prefilter_device(open_states)
             _device_succeeded()
-            STATS["screened"] += len(open_states)
-            STATS["pruned"] += len(open_states) - len(out)
-            STATS["device_screened"] += len(open_states)
+            _stat_add(screened=len(open_states),
+                      pruned=len(open_states) - len(out),
+                      device_screened=len(open_states))
             return out
         except Exception as e:  # bounded backoff, then retry
             _device_failed(e)
@@ -166,8 +182,7 @@ def prefilter_world_states(open_states: List) -> List:
             dropped += 1
         else:
             out.append(ws)
-    STATS["screened"] += len(open_states)
-    STATS["pruned"] += dropped
+    _stat_add(screened=len(open_states), pruned=dropped)
     if dropped:
         log.info("interval pre-filter dropped %d open states", dropped)
     return out
@@ -193,7 +208,7 @@ def _screen_interval(items: List, get_constraints) -> List:
             )
             out = [it for it, k in zip(items, keep) if k]
             _device_succeeded()
-            STATS["device_screened"] += len(items)
+            _stat_add(device_screened=len(items))
         except Exception as e:
             # fall THROUGH to the host screen: a flaky device call must
             # not skip feasibility screening for the wave (sound either
@@ -209,8 +224,7 @@ def _screen_interval(items: List, get_constraints) -> List:
                 pass
             out.append(it)
     dropped = len(items) - len(out)
-    STATS["screened"] += len(items)
-    STATS["pruned"] += dropped
+    _stat_add(screened=len(items), pruned=dropped)
     if dropped:
         log.info("interval pre-filter dropped %d/%d", dropped,
                  len(items))
@@ -222,7 +236,16 @@ def prune_feasible_states(states: List) -> List:
     reference svm.py:319-326): screen the batch through the interval
     domain first and only the survivors pay a solver `is_possible`
     check (which keeps the reference's timeout-means-possible
-    semantics)."""
+    semantics).
+
+    With the persistent solver pool enabled the surviving siblings
+    solve CONCURRENTLY across the pool workers (check_batch's pooled
+    wave); the verdicts still gate the fork on the spot — deferring
+    them would change which states the strategy explores next. The
+    pruner's fully-async seams are the lane engine's fork screen
+    (submit at drain k, collect at drain k+1) and svm's round-boundary
+    open-state prefetch, both of which feed the same verdict cache
+    this path reads (docs/solver_pool.md)."""
     if not states:
         return states
     survivors = _screen_interval(

@@ -383,6 +383,11 @@ def main(argv=None) -> int:
     parser.add_argument("--out-dir", required=True)
     parser.add_argument("--timeout", type=int, default=60)
     parser.add_argument("--tpu-lanes", type=int, default=0)
+    parser.add_argument("--solver-workers", type=int, default=None,
+                        help="persistent solver pool width per rank "
+                        "(smt/solver/pool.py; default: "
+                        "$MTPU_SOLVER_WORKERS or min(4, cpu); 1 = "
+                        "serial single-context solving)")
     parser.add_argument("--no-steal", action="store_true",
                         help="static shards only (no cross-host "
                         "work-stealing when a shard drains early)")
@@ -393,6 +398,12 @@ def main(argv=None) -> int:
     parser.add_argument("files", nargs="+")
     args = parser.parse_args(argv)
 
+    if args.solver_workers is not None:
+        from ..smt.solver.pool import configure_pool
+        from ..support.support_args import args as sargs
+
+        sargs.solver_workers = args.solver_workers
+        configure_pool(workers=args.solver_workers)
     rank = init_distributed(args.coordinator, args.num_processes,
                             args.process_id)
     num_processes = args.num_processes or int(

@@ -30,11 +30,53 @@ execution. This module supplies the planning half of the fix:
 import json
 import logging
 import os
+import sys
 import tempfile
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 log = logging.getLogger(__name__)
+
+#: host-observed worklist peaks keyed by concrete code bytes. Filled
+#: by svm's fork-scale recorder on EVERY run — including host-only
+#: corpus runs, which have no lane engine (and must not import jax
+#: just to record a peak). observed_fork_peak merges this table with
+#: lane_engine.PATH_HISTORY when the lane path is loaded, so
+#: stats.json carries real fork peaks either way (ROADMAP open item:
+#: host-only runs used to persist fork_peak: 0).
+HOST_PEAKS: Dict[bytes, int] = {}
+
+
+def _light_code_bytes(code_obj) -> Optional[bytes]:
+    """Concrete bytecode of a Disassembly without touching the lane
+    path (mirror of lane_engine.code_to_bytes minus the symbolic-tuple
+    folding, which needs support_utils only)."""
+    bc = getattr(code_obj, "bytecode", None)
+    if isinstance(bc, str):
+        try:
+            return bytes.fromhex(bc.replace("0x", ""))
+        except ValueError:
+            return None
+    if isinstance(bc, (bytes, bytearray)):
+        return bytes(bc)
+    if isinstance(bc, tuple):
+        try:
+            from ..support.support_utils import fold_concrete_bytes
+
+            norm = fold_concrete_bytes(bc)
+            if all(isinstance(b, int) for b in norm):
+                return bytes(norm)
+        except Exception:
+            return None
+    return None
+
+
+def record_host_peak(code_obj, peak: int) -> None:
+    """Record a host-worklist fork peak for a contract's code (svm's
+    fork-scale recorder; running max)."""
+    code = _light_code_bytes(code_obj)
+    if code and peak > HOST_PEAKS.get(code, 0):
+        HOST_PEAKS[code] = peak
 
 STATS_NAME = "stats.json"
 
@@ -162,12 +204,19 @@ def warm_path_history(disassembly, name: str,
 
 
 def observed_fork_peak(disassembly) -> int:
-    """The PATH_HISTORY peak recorded for a contract's code during this
-    process's analyses (0 when none / lane path unavailable)."""
-    try:
-        from ..laser.lane_engine import PATH_HISTORY, code_to_bytes
-
-        code = code_to_bytes(disassembly)
-        return int(PATH_HISTORY.get(code, 0)) if code else 0
-    except Exception:  # pragma: no cover
+    """The fork peak recorded for a contract's code during this
+    process's analyses: the max of the host-worklist table (filled on
+    every run, including host-only) and — only when the lane path is
+    already loaded — the lane engine's device-observed PATH_HISTORY.
+    0 when nothing was recorded."""
+    code = _light_code_bytes(disassembly)
+    if code is None:
         return 0
+    peak = int(HOST_PEAKS.get(code, 0))
+    le = sys.modules.get("mythril_tpu.laser.lane_engine")
+    if le is not None:
+        try:
+            peak = max(peak, int(le.PATH_HISTORY.get(code, 0)))
+        except Exception:  # pragma: no cover - lane path optional
+            pass
+    return peak

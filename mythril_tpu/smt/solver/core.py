@@ -19,6 +19,7 @@ uses (reference mythril/laser/smt/solver/solver.py:18-121). Pipeline:
 """
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -287,6 +288,11 @@ class _IncrementalSession:
     def __init__(self):
         self.sat = SatSolver()
         self.blaster = make_blaster(self.sat)
+        # generation stamp: reset_session() bumps the process counter,
+        # invalidating thread-local worker sessions lazily (their
+        # owning threads replace them on next use — a cross-thread
+        # teardown would race the owner mid-solve)
+        self.gen = _SESSION_GEN[0]
         # ackermannization state shared across queries
         self.ack_cache: Dict[int, "T.Term"] = {}  # select/apply tid -> var
         self.select_map: Dict[str, list] = {}
@@ -378,6 +384,48 @@ _session: Optional[_IncrementalSession] = None
 _SESSION_VAR_LIMIT = 3_000_000
 _CORE_CACHE_CAP = 512
 
+#: reset_session() generation counter (see _IncrementalSession.gen)
+_SESSION_GEN = [0]
+
+#: serializes queries against the PROCESS-GLOBAL session. Solver-pool
+#: worker threads each own a thread-local session (set_thread_session)
+#: and never contend here; the lock only matters when a background
+#: orchestration task (async open-state screen, discharge_async
+#: collection) and the main thread both bottom out in the shared
+#: global session.
+_SESSION_LOCK = threading.RLock()
+
+_tls = threading.local()
+
+
+def set_thread_session(sess: Optional[_IncrementalSession]) -> None:
+    """Install (or clear, with None) THIS thread's private incremental
+    session. While set, every check() on this thread runs against it
+    lock-free — the session must be owned by exactly one thread."""
+    _tls.session = sess
+
+
+def ensure_thread_session() -> _IncrementalSession:
+    """This thread's private session, creating one if absent (solver
+    pool worker startup)."""
+    sess = getattr(_tls, "session", None)
+    if sess is None:
+        sess = _IncrementalSession()
+        _tls.session = sess
+    return sess
+
+
+def thread_session() -> Optional[_IncrementalSession]:
+    return getattr(_tls, "session", None)
+
+
+def thread_query_count() -> int:
+    """Queries THIS thread has sent to the core (monotone). The pooled
+    batch layers read the per-thread delta around a call to tell a
+    cache hit from a real solve — the global query_count is shared by
+    every worker and its delta is meaningless under concurrency."""
+    return getattr(_tls, "qcount", 0)
+
 #: unsat-core subsumption effectiveness (read by bench detail)
 CORE_STATS = {"cached": 0, "hits": 0}
 
@@ -386,26 +434,82 @@ INCREMENTAL = True
 
 
 def _get_session() -> _IncrementalSession:
+    sess = getattr(_tls, "session", None)
+    if sess is not None:
+        if (sess.sat.nvars > _SESSION_VAR_LIMIT
+                or sess.gen != _SESSION_GEN[0]):
+            sess = _IncrementalSession()
+            _tls.session = sess
+        return sess
     global _session
-    if _session is None or _session.sat.nvars > _SESSION_VAR_LIMIT:
+    if (_session is None or _session.sat.nvars > _SESSION_VAR_LIMIT
+            or _session.gen != _SESSION_GEN[0]):
         _session = _IncrementalSession()
     return _session
 
 
 def reset_session() -> None:
-    """Drop the shared incremental session. Call between independent
-    analyses (e.g. per contract): constraints from different contracts
-    share no structure, so a stale session only adds dead clauses that
-    every solve must re-satisfy (measured 40x slowdown over an 18-
-    contract sweep)."""
+    """Drop the shared incremental session — and, via the generation
+    counter, every solver-pool worker's thread-local session (each
+    worker replaces its own lazily; tearing one down from here would
+    race its owner mid-solve). Call between independent analyses (e.g.
+    per contract): constraints from different contracts share no
+    structure, so a stale session only adds dead clauses that every
+    solve must re-satisfy (measured 40x slowdown over an 18-contract
+    sweep)."""
     global _session
+    _SESSION_GEN[0] += 1
     _session = None
 
 
+def _solve_cancellable(sat, lits, remaining_s, conflict_budget, cancel):
+    """sat.solve in short slices so a portfolio-race loser can be
+    interrupted between slices (pool.RaceToken.interrupt — the native
+    core has no asynchronous interrupt, but learned clauses and the
+    assumption trail persist across calls, so resuming a slice costs
+    only the assumption re-propagation). Semantics match one
+    solve(timeout=remaining_s, conflicts=conflict_budget) call apart
+    from the cancel exits: True/False are definitive, None means
+    budget exhausted or cancelled."""
+    deadline = time.monotonic() + remaining_s
+    confl0 = sat.stats()["conflicts"]
+    while True:
+        if cancel is not None and cancel():
+            return None
+        left_s = deadline - time.monotonic()
+        if left_s <= 0:
+            return None
+        slice_c = 1024
+        if conflict_budget > 0:
+            left_c = conflict_budget - (sat.stats()["conflicts"]
+                                        - confl0)
+            if left_c <= 0:
+                return None
+            slice_c = min(slice_c, left_c)
+        res = sat.solve(assumptions=lits,
+                        timeout=min(0.05, left_s), conflicts=slice_c)
+        if res is not None:
+            return res
+
+
 def _check_incremental(ctx, work, timeout_s, conflict_budget,
-                       t0) -> CheckContext:
-    """Assumption-based query against the shared session (see
-    _IncrementalSession)."""
+                       t0, cancel=None) -> CheckContext:
+    """Assumption-based query against this thread's session (see
+    _IncrementalSession): a pool worker's private session when one is
+    installed (lock-free — the worker owns it), the process-global
+    session otherwise (under _SESSION_LOCK, so background discharge
+    futures and the main thread cannot interleave on one native
+    solver)."""
+    if thread_session() is not None:
+        return _check_incremental_unlocked(
+            ctx, work, timeout_s, conflict_budget, t0, cancel)
+    with _SESSION_LOCK:
+        return _check_incremental_unlocked(
+            ctx, work, timeout_s, conflict_budget, t0, cancel)
+
+
+def _check_incremental_unlocked(ctx, work, timeout_s, conflict_budget,
+                                t0, cancel=None) -> CheckContext:
     sess = _get_session()
     try:
         lits, expanded = sess.prepare(work)
@@ -416,8 +520,11 @@ def _check_incremental(ctx, work, timeout_s, conflict_budget,
         # rejecting an op) leave consistent state — keep the session and
         # let the one-shot fallback handle this query.
         if sess._dirty:
-            global _session
-            _session = None
+            if getattr(_tls, "session", None) is sess:
+                _tls.session = None
+            else:
+                global _session
+                _session = None
         raise
 
     lit_set = frozenset(lits)
@@ -431,9 +538,14 @@ def _check_incremental(ctx, work, timeout_s, conflict_budget,
     if remaining <= 0:
         ctx.status = UNKNOWN
         return ctx
-    res = sess.sat.solve(
-        assumptions=lits, timeout=remaining, conflicts=conflict_budget
-    )
+    if cancel is None:
+        res = sess.sat.solve(
+            assumptions=lits, timeout=remaining,
+            conflicts=conflict_budget
+        )
+    else:
+        res = _solve_cancellable(sess.sat, lits, remaining,
+                                 conflict_budget, cancel)
     if res is None:
         ctx.status = UNKNOWN
         return ctx
@@ -502,26 +614,37 @@ def check(
     minimize: List["T.Term"] = (),
     maximize: List["T.Term"] = (),
     phase_hint=None,
+    cancel=None,
+    force_oneshot: bool = False,
 ) -> CheckContext:
     """Decide conjunction of Bool terms; optionally lexicographically
     minimize the given BV terms (used by Optimize for tx-sequence
     minimization, reference analysis/solver.py:222-259).
 
+    `cancel` (a nullary callable) makes the underlying CDCL run in
+    interruptible slices — the portfolio-race loser's exit
+    (smt/solver/pool.py); `force_oneshot` skips the incremental
+    session and solves on a fresh instance with equality propagation —
+    the race's second tactic. Both default off and leave the serial
+    path byte-identical.
+
     Every call counts as one solver query in SolverStatistics — this is
     the fresh-solve entry every cache/screen layer above bottoms out in,
     so `query_count`/`solver_time` measure actual solver work (the
-    batched discharge reads the delta to tell a cache hit from a
-    solve)."""
+    batched discharge reads the per-thread delta to tell a cache hit
+    from a solve)."""
     from .solver_statistics import SolverStatistics
 
     ss = SolverStatistics()
-    ss.query_count += 1
+    ss.bump(query_count=1)
+    _tls.qcount = getattr(_tls, "qcount", 0) + 1
     t_q = time.monotonic()
     try:
         return _check_unmeasured(assertions, timeout_s, conflict_budget,
-                                 minimize, maximize, phase_hint)
+                                 minimize, maximize, phase_hint,
+                                 cancel, force_oneshot)
     finally:
-        ss.solver_time += time.monotonic() - t_q
+        ss.bump(solver_time=time.monotonic() - t_q)
 
 
 def _check_unmeasured(
@@ -531,6 +654,8 @@ def _check_unmeasured(
     minimize: List["T.Term"] = (),
     maximize: List["T.Term"] = (),
     phase_hint=None,
+    cancel=None,
+    force_oneshot: bool = False,
 ) -> CheckContext:
     ctx = CheckContext()
     t0 = time.monotonic()
@@ -555,10 +680,11 @@ def _check_unmeasured(
     # (rare; one per reported issue) stay on the one-shot path — their
     # binary-search probes are much cheaper against a small bespoke
     # formula than against the session's accumulated clause set.
-    if INCREMENTAL and not minimize and not maximize:
+    if INCREMENTAL and not minimize and not maximize \
+            and not force_oneshot:
         try:
             return _check_incremental(
-                ctx, work, timeout_s, conflict_budget, t0,
+                ctx, work, timeout_s, conflict_budget, t0, cancel,
             )
         except NotImplementedError:
             pass  # unsupported term shape: fall through to one-shot
@@ -590,7 +716,11 @@ def _check_unmeasured(
     if remaining <= 0:
         ctx.status = UNKNOWN
         return ctx
-    res = sat.solve(timeout=remaining, conflicts=conflict_budget)
+    if cancel is None:
+        res = sat.solve(timeout=remaining, conflicts=conflict_budget)
+    else:
+        res = _solve_cancellable(sat, (), remaining, conflict_budget,
+                                 cancel)
     if res is None:
         ctx.status = UNKNOWN
         return ctx

@@ -52,6 +52,7 @@ blocks, and ``bench.py --smoke``.
 """
 
 import logging
+import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -61,6 +62,19 @@ from .solver_statistics import SolverStatistics
 SAT, UNSAT, UNKNOWN = core.SAT, core.UNSAT, core.UNKNOWN
 
 log = logging.getLogger(__name__)
+
+
+def _locked(fn):
+    """Run a VerdictCache method under the instance lock (re-entrant,
+    so locked methods may call each other)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 #: module switch — bench.py --smoke flips it off for the parity
 #: spot-check; cache() returns None while disabled
@@ -94,6 +108,15 @@ class VerdictCache:
     """Run-wide verdict store keyed by canonical constraint-tid sets."""
 
     def __init__(self):
+        # one re-entrant lock over every public entry point: solver-
+        # pool workers (smt/solver/pool.py) publish proofs and the
+        # caller pre-pass probes concurrently. A fingerprint-striped
+        # scheme was considered and rejected — the trie (_fp/_intern),
+        # the entry LRU and the UNSAT index are shared across any
+        # stripe split, and every critical section is a handful of
+        # dict operations, so stripes would add deadlock surface
+        # without removing contention (docs/solver_pool.md).
+        self._lock = threading.RLock()
         # ordered tid-tuple -> interned frozenset key (the trie: a
         # child extends its parent prefix's key by the delta tid)
         self._fp: Dict[tuple, frozenset] = {}
@@ -104,6 +127,7 @@ class VerdictCache:
 
     # -- fingerprinting ----------------------------------------------------
 
+    @_locked
     def key(self, tids: tuple) -> frozenset:
         """Canonical key for an ORDERED constraint-tid tuple.
 
@@ -155,6 +179,7 @@ class VerdictCache:
                 if not lst:
                     del self._unsat_by_rep[max(old)]
 
+    @_locked
     def record(self, tids, verdict: str, model=None,
                index_unsat: bool = True) -> None:
         """Store a PROVED verdict (and its model) for a tid tuple/list.
@@ -181,6 +206,7 @@ class VerdictCache:
 
     # -- tier 1: ancestor-UNSAT subsumption --------------------------------
 
+    @_locked
     def ancestor_unsat(self, ks: frozenset) -> bool:
         idx = self._unsat_by_rep
         if not idx:
@@ -242,6 +268,7 @@ class VerdictCache:
             return None
         return True
 
+    @_locked
     def probe(self, terms: Sequence, tids: Optional[tuple] = None,
               shadow: bool = True):
         """(verdict | None, ModelData | None) for a raw-term conjunction.
@@ -291,6 +318,7 @@ class VerdictCache:
         except Exception:
             return False
 
+    @_locked
     def shadow_prepass(self, term_sets: Sequence[Sequence],
                        undecided: Sequence[int]) -> Dict[int, bool]:
         """Device-batched tier-2 shadow over a query wave.
@@ -342,6 +370,7 @@ class VerdictCache:
 
     # -- tier 3: interval-bound inheritance --------------------------------
 
+    @_locked
     def bounds_for(self, raws: Sequence, tids: tuple) -> dict:
         """{var_tid: (var, lo, hi)} merged syntactic bounds for the
         system, inheriting the longest cached prefix's bounds and
@@ -373,6 +402,7 @@ class VerdictCache:
 
     # -- migration shipping (parallel/migrate.py) --------------------------
 
+    @_locked
     def export_entries(self, term_lists: Sequence[Sequence]) -> List:
         """Cached proofs restricted to the given states' constraint
         prefixes, as ``(ordered terms, verdict, model)`` triples ready
@@ -420,6 +450,7 @@ class VerdictCache:
         SolverStatistics().verdicts_shipped += len(entries)
         return entries
 
+    @_locked
     def import_entries(self, entries: Sequence) -> int:
         """Record shipped proofs under THIS process's term table (the
         terms re-interned on load carry this table's tids). Returns the
@@ -438,6 +469,7 @@ class VerdictCache:
         SolverStatistics().verdicts_replayed += n
         return n
 
+    @_locked
     def interval_unsat(self, assertions: Sequence) -> bool:
         """state_infeasible with inherited bound seeds; a refutation is
         a sound proof and is recorded for ancestor subsumption."""

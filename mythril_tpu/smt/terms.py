@@ -87,15 +87,48 @@ class Term:
 _table: Dict[tuple, Term] = {}
 _next_tid = [1]
 
+#: miss-path interning lock (None = single-threaded fast path). The
+#: solver pool (smt/solver/pool.py) flips it on before its workers
+#: start: two threads racing the miss path would otherwise intern two
+#: Terms with distinct tids for one structural key, breaking the
+#: tid-set fingerprints every cache layer keys on. The hit path stays
+#: lock-free — an interned entry is immutable and dict reads are
+#: atomic under the GIL — so single-threaded construction cost is
+#: unchanged.
+_INTERN_LOCK = None
+
+
+def set_thread_safe_interning(enabled: bool = True) -> None:
+    """Serialize the interning MISS path across threads (idempotent;
+    there is no reason to ever turn it back off mid-process)."""
+    global _INTERN_LOCK
+    if enabled and _INTERN_LOCK is None:
+        import threading
+
+        _INTERN_LOCK = threading.Lock()
+    elif not enabled:
+        _INTERN_LOCK = None
+
 
 def _intern(op, args=(), params=(), width=0, val=None, name=None) -> Term:
     key = (op, tuple(a.tid for a in args), params, width, val, name)
     t = _table.get(key)
-    if t is None:
+    if t is not None:
+        return t
+    lock = _INTERN_LOCK
+    if lock is None:
         t = Term(op, tuple(args), params, width, val, name, _next_tid[0])
         _next_tid[0] += 1
         _table[key] = t
-    return t
+        return t
+    with lock:
+        t = _table.get(key)  # re-check: the race this lock exists for
+        if t is None:
+            t = Term(op, tuple(args), params, width, val, name,
+                     _next_tid[0])
+            _next_tid[0] += 1
+            _table[key] = t
+        return t
 
 
 def dag_size() -> int:

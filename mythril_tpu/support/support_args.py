@@ -31,6 +31,11 @@ class Args(object, metaclass=Singleton):
         # on corpus runs — every entry pins a Model and its eval memos.
         self.model_lru_size = 2 ** 14
         self.pruning_factor: Optional[float] = None
+        # persistent solver pool width (smt/solver/pool.py): None =
+        # auto (MTPU_SOLVER_WORKERS env, else min(4, cpu)); 1 = serial
+        # fallback (today's single-context behavior, bit-for-bit);
+        # >1 = that many long-lived solver worker threads
+        self.solver_workers: Optional[int] = None
         # TPU lane-engine knobs (new in this build)
         # -1 = auto (batched lanes on a local accelerator, host-only
         # otherwise — support/devices.default_tpu_lanes); 0 = host-only

@@ -66,14 +66,25 @@ class ModelCache:
     MIN_SCAN = 4
 
     def __init__(self):
+        import threading
+
         from ..smt.repair import REPAIR_MODELS
 
         self.model_cache = LRUCache(size=100)
         self._scan = self.MAX_SCAN
         self._misses = 0
         self._repair_tries = REPAIR_MODELS
+        # solver-pool workers and async discharge futures feed/scan
+        # the cache concurrently with the main thread; the scan
+        # iterates the LRU's OrderedDict, which a concurrent put()
+        # would invalidate mid-iteration (smt/solver/pool.py)
+        self._lock = threading.RLock()
 
     def check_quick_sat(self, constraint_term) -> object:
+        with self._lock:
+            return self._check_quick_sat_locked(constraint_term)
+
+    def _check_quick_sat_locked(self, constraint_term) -> object:
         scanned = 0
         for model in reversed(self.model_cache.lru_cache.keys()):
             if scanned >= self._scan:
@@ -138,14 +149,16 @@ class ModelCache:
         return None
 
     def put(self, model, weight) -> None:
-        self.model_cache.put(model, weight)
+        with self._lock:
+            self.model_cache.put(model, weight)
 
     def most_recent(self):
         """Newest cached model, or None (phase-seed donor even when
         quick-sat misses)."""
-        for model in reversed(self.model_cache.lru_cache.keys()):
-            return model
-        return None
+        with self._lock:
+            for model in reversed(self.model_cache.lru_cache.keys()):
+                return model
+            return None
 
 
 def fold_concrete_bytes(seq) -> list:

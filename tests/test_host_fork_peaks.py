@@ -1,0 +1,83 @@
+"""Host-only fork-peak persistence (parallel/cost_model.HOST_PEAKS +
+laser/svm's ungated fork-scale recorder): a corpus run with no lane
+engine must still persist nonzero fork peaks to stats.json so the next
+run's pick_width / LPT warm start has real data (ROADMAP open item:
+host-only runs used to write ``fork_peak: 0``)."""
+
+import json
+
+from mythril_tpu.parallel import cost_model
+from mythril_tpu.support.support_args import args
+
+
+class _FakeDisassembly:
+    def __init__(self, bytecode):
+        self.bytecode = bytecode
+
+
+def test_record_and_observe_host_peak_roundtrip():
+    """record_host_peak keeps a running max keyed by concrete code
+    bytes, without any lane-engine import; observed_fork_peak reads it
+    back for stats persistence."""
+    dis = _FakeDisassembly("600160015600")
+    cost_model.record_host_peak(dis, 7)
+    cost_model.record_host_peak(dis, 3)  # running max: no downgrade
+    assert cost_model.observed_fork_peak(dis) == 7
+    cost_model.record_host_peak(_FakeDisassembly(b"\x60\x01"), 2)
+    assert cost_model.observed_fork_peak(
+        _FakeDisassembly(b"\x60\x01")) == 2
+    # symbolic bytecode (tuple with non-int entries): unrecordable,
+    # never a crash
+    cost_model.record_host_peak(_FakeDisassembly(("sym",)), 9)
+    assert cost_model.observed_fork_peak(
+        _FakeDisassembly(("sym",))) == 0
+
+
+def test_host_peak_persists_to_stats_json(tmp_path):
+    """The corpus persistence path: a result row built from
+    observed_fork_peak lands as a nonzero fork_peak in stats.json and
+    survives the load/merge cycle."""
+    dis = _FakeDisassembly("6001600255")
+    cost_model.record_host_peak(dis, 12)
+    row = {"contract": "host_only.sol.o", "wall_s": 1.5,
+           "fork_peak": cost_model.observed_fork_peak(dis)}
+    assert row["fork_peak"] == 12
+    cost_model.save_stats(tmp_path, [row])
+    data = json.loads((tmp_path / "stats.json").read_text())
+    assert data["contracts"]["host_only.sol.o"]["fork_peak"] == 12
+    # merge keeps the running max
+    cost_model.save_stats(tmp_path, [dict(row, fork_peak=5)])
+    stats = cost_model.load_stats(tmp_path)
+    assert stats["host_only.sol.o"]["fork_peak"] == 12
+
+
+def test_host_only_analysis_records_nonzero_peak(tmp_path):
+    """End to end: a HOST-ONLY symbolic run (tpu_lanes=0) over a
+    forking contract records a nonzero worklist peak, and the corpus
+    persistence flow writes it to stats.json — previously 0 because
+    the recorder was gated on tpu_lanes."""
+    from tests.harness import analyze_runtime, asm
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    # two symbolic JUMPI forks driven by calldata
+    prog = asm("PUSH1", b"\x00", "CALLDATALOAD", "PUSH1", b"\x07",
+               "JUMPI", "STOP", "JUMPDEST",
+               "PUSH1", b"\x20", "CALLDATALOAD", "PUSH1", b"\x11",
+               "JUMPI", "STOP", "JUMPDEST",
+               "PUSH1", b"\x01", "PUSH1", b"\x00", "SSTORE", "STOP")
+    runtime_hex = prog.hex()
+    contract = EVMContract(code=runtime_hex, name="host_forks")
+    old_lanes = args.tpu_lanes
+    args.tpu_lanes = 0  # host-only: the lane engine must not engage
+    try:
+        analyze_runtime(runtime_hex, ["Exceptions"], tx_count=1,
+                        name="host_forks", contract=contract)
+    finally:
+        args.tpu_lanes = old_lanes
+    peak = cost_model.observed_fork_peak(contract.disassembly)
+    assert peak > 0
+    cost_model.save_stats(
+        tmp_path, [{"contract": "host_forks.sol.o", "wall_s": 0.5,
+                    "fork_peak": peak}])
+    stats = cost_model.load_stats(tmp_path)
+    assert stats["host_forks.sol.o"]["fork_peak"] == peak

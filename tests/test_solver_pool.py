@@ -1,0 +1,235 @@
+"""Persistent solver pool (smt/solver/pool.py + docs/solver_pool.md):
+verdict parity of the pooled trie-sharded discharge against the serial
+single-context walk over randomized constraint trees (K=1/2/4, racing
+on and off), VerdictCache-content equality after a concurrent run,
+worker-death serial re-discharge, forced portfolio races, and the
+discharge_async futures seam."""
+
+import random
+
+import pytest
+
+from mythril_tpu.laser.state.constraints import Constraints
+from mythril_tpu.smt import ULE, ULT, symbol_factory
+from mythril_tpu.smt import terms as T
+from mythril_tpu.smt.solver import batch as solver_batch
+from mythril_tpu.smt.solver import pool as pool_mod
+from mythril_tpu.smt.solver import verdicts
+from mythril_tpu.smt.solver.core import reset_session
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support.model import check_batch, get_model
+
+_N = [0]
+
+
+def _fresh(name):
+    """Per-test-unique symbols: terms are interned process-wide, so
+    reused names would leak verdicts between tests."""
+    _N[0] += 1
+    return symbol_factory.BitVecSym(f"pool_{name}_{_N[0]}", 256)
+
+
+def _bv(v):
+    return symbol_factory.BitVecVal(v, 256)
+
+
+@pytest.fixture(autouse=True)
+def _serial_pool_and_fresh_cache():
+    """Every test starts serial with an empty run-wide cache and MUST
+    leave the process pool serial (the rest of the suite assumes the
+    single-context path)."""
+    pool_mod.configure_pool(workers=1)
+    verdicts.reset_cache()
+    reset_session()
+    yield
+    pool_mod.configure_pool(workers=1)
+    verdicts.reset_cache()
+    reset_session()
+
+
+def _random_tree_sets(rng, n_roots=3, depth=3, fanout=2):
+    """Randomized tail-extension constraint trees (the monotone
+    path-growth shape): each child extends its parent's ordered list,
+    so ancestor/descendant relations keep a common first constraint —
+    one trie subtree per root. Some branches are contradictory."""
+    sets = []
+    for r in range(n_roots):
+        syms = [_fresh(f"t{r}")for _ in range(3)]
+        root = [ULE(_bv(1), syms[0]), ULE(syms[0], _bv(1 << 20))]
+
+        def grow(prefix, d):
+            sets.append([c.raw for c in prefix])
+            if d == 0:
+                return
+            for _ in range(fanout):
+                s = rng.choice(syms)
+                bound = rng.randrange(1, 1 << 16)
+                kind = rng.randrange(3)
+                if kind == 0:
+                    c = ULE(s, _bv(bound))
+                elif kind == 1:
+                    c = ULE(_bv(bound), s)
+                else:
+                    c = ULT(s, _bv(bound))
+                grow(prefix + [c], d - 1)
+
+        grow(root, depth)
+    return sets
+
+
+def _cache_entries():
+    """{fingerprint key: verdict} snapshot of the run-wide cache."""
+    vc = verdicts.cache()
+    return {ks: e.verdict for ks, e in vc._entries.items()
+            if e.verdict is not None}
+
+
+def _run_discharge(sets, workers, racing):
+    pool_mod.configure_pool(workers=workers, racing=racing)
+    verdicts.reset_cache()
+    reset_session()
+    out = solver_batch.discharge(sets, timeout_s=5.0)
+    return out, _cache_entries()
+
+
+def test_pooled_discharge_parity_randomized_trees():
+    """Pooled discharge (K=1/2/4, racing on/off) must return verdicts
+    identical to the serial single-context walk over a randomized
+    tail-extension tree corpus, and the VerdictCache contents after a
+    concurrent run must equal the serial run's."""
+    rng = random.Random(0x9001)
+    sets = _random_tree_sets(rng)
+    assert len(sets) > 20
+    serial, serial_entries = _run_discharge(sets, workers=1,
+                                            racing=False)
+    assert "unknown" not in serial  # decidable corpus: parity is exact
+    for workers in (1, 2, 4):
+        for racing in (False, True):
+            got, entries = _run_discharge(sets, workers=workers,
+                                          racing=racing)
+            assert got == serial, (workers, racing)
+            assert entries == serial_entries, (workers, racing)
+
+
+def test_pooled_check_batch_matches_is_possible():
+    """The pooled check_batch wave must agree with one-by-one
+    is_possible (computed serially, pool at K=1) — including
+    UNSAT-subset members answered by the cross-worker registry."""
+    x, y = _fresh("cbx"), _fresh("cby")
+    prefix = [ULE(_bv(16), x), ULE(x, _bv(4096))]
+    sets = [Constraints(prefix + [ULE(y, x + _bv(j))])
+            for j in range(6)]
+    contra = Constraints([ULT(x, _bv(4)), ULE(_bv(9), x)])
+    sets.append(contra)
+    sets += [Constraints(list(contra) + [ULE(y, _bv(j))])
+             for j in range(3)]
+    expected = [Constraints(list(s)).is_possible() for s in sets]
+
+    pool_mod.configure_pool(workers=4)
+    verdicts.reset_cache()
+    reset_session()
+    get_model.cache_clear()
+    assert check_batch(sets) == expected
+
+
+def test_worker_death_serial_requery_parity():
+    """A worker dying mid-batch (unexpected exception) must hand its
+    in-flight and queued queries back for serial re-discharge — the
+    verdicts still equal the serial run's, and worker_deaths counts
+    the losses."""
+    rng = random.Random(0xDEAD)
+    sets = _random_tree_sets(rng, n_roots=2, depth=3)
+    serial, _ = _run_discharge(sets, workers=1, racing=False)
+
+    pool = pool_mod.configure_pool(workers=2, racing=False)
+    verdicts.reset_cache()
+    reset_session()
+    ss = SolverStatistics()
+    deaths0 = ss.worker_deaths
+    remaining = [2]  # kill both workers on their first task
+
+    def injector(worker_idx, task):
+        if remaining[0] > 0:
+            remaining[0] -= 1
+            raise RuntimeError("rigged solver crash")
+
+    pool.fail_injector = injector
+    try:
+        got = solver_batch.discharge(sets, timeout_s=5.0)
+    finally:
+        pool.fail_injector = None
+    assert got == serial
+    assert ss.worker_deaths >= deaths0 + 2
+
+
+def test_portfolio_race_parity_and_counters():
+    """With a rigged one-conflict first budget every nontrivial query
+    escalates to the 2-tactic race; verdicts must still equal the
+    serial full-budget run and the race counters must move."""
+    sets = []
+    for j in range(6):
+        x, y = _fresh("rx"), _fresh("ry")
+        # small factoring instances: decidable fast at full budget,
+        # but never by unit propagation alone — the 1-conflict first
+        # attempt comes back UNKNOWN and the race must finish the job
+        sets.append([
+            T.mk_eq(T.mk_mul(x.raw, y.raw), _bv(3233 + 2 * j).raw),
+            T.mk_ule(_bv(2).raw, x.raw), T.mk_ule(_bv(2).raw, y.raw),
+            T.mk_ult(x.raw, _bv(1 << 16).raw),
+            T.mk_ult(y.raw, _bv(1 << 16).raw),
+        ])
+    serial, _ = _run_discharge(sets, workers=1, racing=False)
+    assert "unknown" not in serial
+
+    ss = SolverStatistics()
+    races0 = ss.portfolio_races
+    pool_mod.configure_pool(workers=2, racing=True,
+                            first_timeout_s=0.001, first_conflicts=1)
+    verdicts.reset_cache()
+    reset_session()
+    got = solver_batch.discharge(sets, timeout_s=10.0)
+    assert got == serial
+    assert ss.portfolio_races > races0
+    assert sum(ss.races_won_by_tactic.values()) > 0
+
+
+def test_discharge_async_future_and_overlap():
+    """discharge_async returns the same verdicts as the synchronous
+    call; collection books nonzero async_overlap_ms when the caller
+    did other work between submit and collect; at K=1 the future is
+    already complete at submit (serial semantics)."""
+    import time
+
+    rng = random.Random(0xA51C)
+    sets = _random_tree_sets(rng, n_roots=2, depth=2)
+    serial, _ = _run_discharge(sets, workers=1, racing=False)
+
+    # K=1: inline execution, future completed before result()
+    verdicts.reset_cache()
+    reset_session()
+    fut = solver_batch.discharge_async(sets, timeout_s=5.0)
+    assert fut.done()
+    assert fut.result() == serial
+
+    pool_mod.configure_pool(workers=2)
+    verdicts.reset_cache()
+    reset_session()
+    ss = SolverStatistics()
+    overlap0 = ss.async_overlap_ms
+    fut = solver_batch.discharge_async(sets, timeout_s=5.0)
+    time.sleep(0.05)  # the "device window" the solve hides behind
+    assert fut.result() == serial
+    assert ss.async_overlap_ms > overlap0
+
+
+def test_serial_fallback_is_the_serial_path():
+    """At K=1 discharge must route through the unchanged serial body
+    (pool.parallel False) — the bit-for-bit fallback contract."""
+    pool = pool_mod.configure_pool(workers=1)
+    assert not pool.parallel
+    ss = SolverStatistics()
+    pooled0 = ss.queries_pooled
+    x = _fresh("sf")
+    out = solver_batch.discharge([[T.mk_ule(_bv(3).raw, x.raw)]])
+    assert out == ["sat"]
+    assert ss.queries_pooled == pooled0  # nothing went to the pool
