@@ -957,10 +957,160 @@ def _smoke_pool():
     return result
 
 
+def _smoke_propagate():
+    """Stage 6: the bidirectional-propagation gate
+    (docs/propagation.md).
+
+    A rigged mix the forward interval-only screen PROVABLY cannot
+    kill: bit conflicts through a shared masked subterm
+    (`x & 0xff == 0x42  /\\  x & 0xff == 0x43` — both equalities stay
+    may-true under intervals, but backward EQ-pinning forces the
+    shared node's known bits both ways) and unit-propagation chains
+    (`not(a or b)  /\\  a`). The mix runs through the REAL
+    `check_batch` seam with the device screen forced on
+    (args.tpu_lanes), twice:
+
+    1. propagation on (MTPU_PROPAGATE default): gates nonzero
+       `propagate_kills`, nonzero `facts_harvested` +
+       `hinted_solves` from the satisfiable tail, and correct
+       verdicts;
+    2. interval-only (propagate.FORCE=False, fresh verdict cache /
+       sessions / get_model memo): final verdicts must be IDENTICAL —
+       the screen may only change cost, never results.
+
+    Plus a randomized SAT-preservation spot check: over random
+    constraint trees, any set the screen kills must be UNSAT under
+    the direct solver. Any miss exits 1."""
+    import random
+
+    from mythril_tpu.laser.state.constraints import Constraints
+    from mythril_tpu.models import pruner
+    from mythril_tpu.ops import propagate
+    from mythril_tpu.smt import terms as T
+    from mythril_tpu.smt.solver import core as solver_core
+    from mythril_tpu.smt.solver import verdicts as verdict_mod
+    from mythril_tpu.smt.solver.core import reset_session
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support import model as support_model
+    from mythril_tpu.support.model import check_batch
+    from mythril_tpu.support.support_args import args as sargs
+
+    ss = SolverStatistics()
+    bv = lambda v, w=256: T.bv_const(v, w)  # noqa: E731
+    x = T.bv_var("prop_smoke_x", 256)
+    y = T.bv_var("prop_smoke_y", 256)
+    a, b = T.bool_var("prop_smoke_a"), T.bool_var("prop_smoke_b")
+
+    def wrap(terms):
+        from mythril_tpu.smt.bool import Bool
+
+        return Constraints([Bool(t) for t in terms])
+
+    sets = []
+    # bit conflicts: same masked subterm pinned to two values
+    for j in range(4):
+        sets.append(wrap([
+            T.mk_eq(T.mk_and(x, bv(0xFF << (8 * j))),
+                    bv(0x42 << (8 * j))),
+            T.mk_eq(T.mk_and(x, bv(0xFF << (8 * j))),
+                    bv(0x43 << (8 * j))),
+        ]))
+    # bool unit-propagation chain
+    sets.append(wrap([T.mk_not(T.mk_bool_or(a, b)), a]))
+    # satisfiable tail with harvestable facts (known-bit masks +
+    # tightened bounds hint the surviving solves)
+    for j in range(4):
+        sets.append(wrap([
+            T.mk_eq(T.mk_and(x, bv(0xFF)), bv(0x40 | j)),
+            T.mk_ule(x, bv(1 << 20)), T.mk_ule(y, x),
+        ]))
+
+    old_lanes = sargs.tpu_lanes
+    sargs.tpu_lanes = 8
+    # the smoke may run against a tunneled backend (threshold 4096)
+    # or after a device hiccup tripped the backoff — force the screen
+    # to actually engage for this stage
+    old_thresh = pruner.DEVICE_BATCH_THRESHOLD_TUNNELED
+    pruner.DEVICE_BATCH_THRESHOLD_TUNNELED = 4
+    pruner._device_failures = 0
+    pruner._device_skip = 0
+    c0 = dict(ss.batch_counters())
+    try:
+        propagate.FORCE = True
+        verdict_mod.reset_cache()
+        reset_session()
+        support_model.get_model.cache_clear()
+        with_prop = check_batch(sets)
+        c1 = dict(ss.batch_counters())
+
+        propagate.FORCE = False  # interval-only reference pass
+        verdict_mod.reset_cache()
+        reset_session()
+        support_model.get_model.cache_clear()
+        interval_only = check_batch(sets)
+
+        # randomized SAT-preservation: any screen kill must be a real
+        # UNSAT (the property test in tests/test_propagate.py runs the
+        # full 200-tree corpus; this is the CI-fast spot check)
+        propagate.FORCE = None
+        rng = random.Random(0xBEEF)
+        syms = [T.bv_var(f"prop_smoke_r{i}", 64) for i in range(3)]
+        b64 = lambda v: T.bv_const(v, 64)  # noqa: E731
+        rsets = []
+        for _ in range(24):
+            terms = []
+            for _ in range(rng.randrange(2, 5)):
+                s = rng.choice(syms)
+                e = (T.mk_and(s, b64(rng.randrange(1, 1 << 10)))
+                     if rng.random() < 0.5 else
+                     T.mk_add(s, b64(rng.randrange(1, 256))))
+                k = rng.randrange(3)
+                c = (T.mk_eq if k == 0
+                     else T.mk_ult if k == 1 else T.mk_ule)(
+                    e, b64(rng.randrange(0, 1 << 10)))
+                terms.append(c)
+            rsets.append(terms)
+        keep = propagate.prefilter_feasible(rsets)
+        unsound = 0
+        for terms, k in zip(rsets, keep):
+            if not k:
+                ctx = solver_core.check(list(terms), timeout_s=10.0)
+                if ctx.status != solver_core.UNSAT:
+                    unsound += 1
+    finally:
+        propagate.FORCE = None
+        sargs.tpu_lanes = old_lanes
+        pruner.DEVICE_BATCH_THRESHOLD_TUNNELED = old_thresh
+        verdict_mod.reset_cache()
+        reset_session()
+        support_model.get_model.cache_clear()
+
+    delta = {k: round(c1[k] - c0.get(k, 0), 1)
+             for k in ("propagate_kills", "propagate_sweeps",
+                       "facts_harvested", "hinted_solves")}
+    result = dict(
+        delta,
+        queries=len(sets),
+        verdicts_identical=with_prop == interval_only,
+        killed=len(with_prop) - sum(with_prop),
+        sat_preservation={"screened": len(rsets),
+                          "killed": int(len(keep) - keep.sum()),
+                          "unsound": unsound},
+    )
+    result["ok"] = bool(
+        result["propagate_kills"] > 0
+        and result["facts_harvested"] > 0
+        and result["hinted_solves"] > 0
+        and result["verdicts_identical"]
+        and unsound == 0
+    )
+    return result
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Five stages:
+    run-wide verdict cache — NO full corpus sweep. Six stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -988,7 +1138,16 @@ def bench_smoke():
        and nonzero portfolio_races / async_overlap_ms. Any miss
        exits 1. Stages 1-4 run BEFORE the pool stage with the pool at
        its default (K=1 on small CI boxes), so `MTPU_SOLVER_WORKERS=1`
-       leaves their results byte-identical to the pre-pool build.
+       leaves their results byte-identical to the pre-pool build;
+    6. the bidirectional-propagation gate (_smoke_propagate,
+       docs/propagation.md): nonzero propagate_kills on a rigged
+       bit-conflict/unit-propagation mix interval-only screening
+       provably cannot kill, fact harvest + hinted solves on the
+       satisfiable tail, verdict identity vs interval-only mode, and
+       a randomized SAT-preservation spot check. Any miss exits 1.
+       Stages 1-5 run BEFORE it at the default device config
+       (tpu_lanes auto -> 0 on CI CPU boxes), so their results stay
+       byte-identical to the pre-propagation build.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -1123,6 +1282,18 @@ def bench_smoke():
     else:
         out["pool"] = {"skipped": True, "ok": True}
 
+    # stage 6: the bidirectional-propagation gate (rigged bit-conflict
+    # mix, interval-only parity, SAT-preservation spot check;
+    # skippable for the quick inner loop via MTPU_SMOKE_PROPAGATE=0)
+    if os.environ.get("MTPU_SMOKE_PROPAGATE", "1") != "0":
+        try:
+            out["propagate"] = _smoke_propagate()
+        except Exception as e:
+            out["propagate"] = {"ok": False, "error": type(e).__name__,
+                                "detail": str(e)[:200]}
+    else:
+        out["propagate"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -1142,7 +1313,10 @@ def bench_smoke():
           and out["steal"].get("ok", False)
           # the pool gate: verdict identity, pooled wall <= serial,
           # nonzero races and async overlap
-          and out["pool"].get("ok", False))
+          and out["pool"].get("ok", False)
+          # the propagation gate: rigged-mix kills, fact harvest,
+          # hinted solves, interval-only parity, SAT preservation
+          and out["propagate"].get("ok", False))
     return 0 if ok else 1
 
 

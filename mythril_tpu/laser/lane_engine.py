@@ -2306,7 +2306,11 @@ class LaneEngine:
         (smt/solver/verdicts.py): a prefix refuted in any earlier
         window or call site kills its descendants here without a
         solve, and prefixes this screen refutes kill the open-state
-        screen's supersets later."""
+        screen's supersets later. With MTPU_PROPAGATE on the
+        discharge additionally runs the bidirectional propagation
+        prescreen FIRST (ops/propagate.py): product-domain kills
+        before any solver work, and harvested facts hint the solves
+        that survive (docs/propagation.md)."""
         from ..smt import Model
         from ..smt.solver import batch as solver_batch
         from ..support.model import model_cache

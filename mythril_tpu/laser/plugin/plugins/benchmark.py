@@ -100,6 +100,20 @@ class BenchmarkPlugin(LaserPlugin):
                 counters["verdict_bound_seeds"],
                 counters["queries_saved"],
             )
+            # bidirectional propagation screen (docs/propagation.md):
+            # product-domain lane kills, fixpoint sweeps, harvested
+            # facts and the solves they hinted
+            if counters["propagate_kills"] or \
+                    counters["facts_harvested"] or \
+                    counters["hinted_solves"]:
+                log.info(
+                    "Propagation: kills=%d sweeps=%d facts=%d "
+                    "hinted_solves=%d",
+                    counters["propagate_kills"],
+                    counters["propagate_sweeps"],
+                    counters["facts_harvested"],
+                    counters["hinted_solves"],
+                )
             # persistent solver pool (docs/solver_pool.md): worker
             # count, pooled queries, portfolio races (and which tactic
             # won them), affinity hits, deaths, and the solver wall

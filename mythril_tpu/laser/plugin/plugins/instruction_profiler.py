@@ -90,6 +90,20 @@ class InstructionProfiler(LaserPlugin):
                     counters["verdict_bound_seeds"],
                     counters["queries_saved"],
                 ))
+            # bidirectional propagation screen (docs/propagation.md):
+            # product-domain lane kills, fixpoint sweeps, harvested
+            # facts and the solves they hinted
+            if counters["propagate_kills"] or \
+                    counters["facts_harvested"] or \
+                    counters["hinted_solves"]:
+                lines.append(
+                    "Propagation: kills={} sweeps={} facts={} "
+                    "hinted_solves={}".format(
+                        counters["propagate_kills"],
+                        counters["propagate_sweeps"],
+                        counters["facts_harvested"],
+                        counters["hinted_solves"],
+                    ))
             # persistent solver pool (docs/solver_pool.md)
             if counters["pool_workers"] > 1 or \
                     counters["queries_pooled"]:

@@ -340,8 +340,12 @@ class LaserEVM:
 
     def _screen_open_states(self, open_states):
         """The reachability screen body. With the TPU pre-filter
-        enabled, interval-infeasible states are dropped in batch before any
-        solver query."""
+        enabled, interval-infeasible states are dropped in batch before
+        any solver query — and with MTPU_PROPAGATE on (the default)
+        that screen is the bidirectional product-domain fixpoint
+        (ops/propagate.py): known-bits x interval kills the forward
+        pass cannot make, plus harvested facts that hint the surviving
+        check_batch solves (docs/propagation.md)."""
         if args.tpu_prefilter:
             try:
                 from ..models.pruner import prefilter_world_states

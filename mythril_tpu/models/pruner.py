@@ -97,12 +97,28 @@ def _device_should_try() -> bool:
         return True
 
 
-def _device_failed(e: Exception) -> None:
-    global _device_failures, _device_skip
+#: fatal exception classes: a user interrupt or an out-of-memory is
+#: NOT a device hiccup — swallowing it into the backoff would silently
+#: disable the device screen (and hide the OOM) for the rest of a
+#: corpus run
+_FATAL = (KeyboardInterrupt, MemoryError)
+_warned_disable = False
+
+
+def _device_failed(e: BaseException) -> None:
+    global _device_failures, _device_skip, _warned_disable
+    if isinstance(e, _FATAL):
+        raise e
     with _stats_lock:
         _device_failures += 1
         _device_skip = min(2 ** _device_failures, _MAX_SKIP)
-    log.warning(
+        first = not _warned_disable
+        _warned_disable = True
+    # the FIRST disable reason lands at WARNING (it explains every
+    # later host-screened wave); repeats stay at DEBUG so a flaky
+    # link does not flood the log
+    log.log(
+        logging.WARNING if first else logging.DEBUG,
         "device interval screening failed (%s); falling back to host "
         "screening, retrying the device in %d calls", e, _device_skip,
     )
@@ -201,9 +217,7 @@ def _screen_interval(items: List, get_constraints) -> List:
         and _device_should_try()
     ):
         try:
-            from ..ops.intervals import prefilter_feasible
-
-            keep = prefilter_feasible(
+            keep = _device_prefilter(
                 [[c.raw for c in get_constraints(it)] for it in items]
             )
             out = [it for it, k in zip(items, keep) if k]
@@ -274,10 +288,23 @@ def prune_feasible_states(states: List) -> List:
     ]
 
 
-def _prefilter_device(open_states: List) -> List:
+def _device_prefilter(assertion_sets):
+    """The device feasibility screen: the bidirectional product-domain
+    fixpoint (ops/propagate.py — kills more lanes AND harvests facts
+    that hint the surviving solves) when MTPU_PROPAGATE is on, the
+    forward interval-only pass (ops/intervals.py) otherwise —
+    bit-for-bit the pre-propagation behavior."""
+    from ..ops import propagate
+
+    if propagate.enabled():
+        return propagate.prefilter_feasible(assertion_sets)
     from ..ops.intervals import prefilter_feasible
 
-    keep = prefilter_feasible(
+    return prefilter_feasible(assertion_sets)
+
+
+def _prefilter_device(open_states: List) -> List:
+    keep = _device_prefilter(
         [[c.raw for c in _all_constraints(ws.constraints)]
          for ws in open_states]
     )

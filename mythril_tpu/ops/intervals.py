@@ -110,7 +110,8 @@ class EncodedDAG:
     """Host-side linearization of a term-DAG union into level tensors."""
 
     def __init__(self, n_nodes, levels, init_lo, init_hi, seed_idx, seed_lo,
-                 seed_hi, dead, assert_idx, assert_mask):
+                 seed_hi, dead, assert_idx, assert_mask, n_real=None,
+                 host=None):
         self.n_nodes = n_nodes
         self.levels = levels  # list of dicts of per-level arrays
         self.init_lo = init_lo  # (T, 8) uint32 shared defaults
@@ -121,6 +122,15 @@ class EncodedDAG:
         self.dead = dead  # (S,) bool — contradictory bounds, pre-pruned
         self.assert_idx = assert_idx  # (S, A) int32 node index per assertion
         self.assert_mask = assert_mask  # (S, A) bool
+        # logical lane count: the state axis buckets to a power of two
+        # under CANONICAL_KEYS (pad lanes seeded TOP, no live
+        # assertions, marked dead-on-arrival), so sibling-wave sizes
+        # stop forking fresh XLA variants of the level kernels
+        self.n_real = seed_idx.shape[0] if n_real is None else n_real
+        # host-side node tables (numpy; kept for the propagation kernel
+        # — ops/propagate.py builds its backward/product-domain plan
+        # from these instead of re-walking the term DAG)
+        self.host = host or {}
 
 
 def _word(v: int) -> np.ndarray:
@@ -330,10 +340,23 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]],
     all_bounds = ([{} for _ in assertion_sets] if pinned
                   else [extract_bounds(s) for s in assertion_sets])
     max_v = max((len(b) for b in all_bounds), default=1) or 1
-    seed_idx = np.full((n_states, max_v), n, dtype=np.int32)
-    seed_lo = np.zeros((n_states, max_v, bv256.NLIMBS), dtype=np.uint32)
-    seed_hi = np.zeros((n_states, max_v, bv256.NLIMBS), dtype=np.uint32)
-    dead = np.zeros(n_states, dtype=bool)
+    max_a = max((len(s) for s in assertion_sets), default=1) or 1
+    # the seed/assert tables bucket BOTH free axes the way the node
+    # tables already bucket: the per-state slot counts (V, A) and the
+    # lane count S pad to powers of two, so a wave of 9 siblings with
+    # 3 seeded vars reuses the level kernels compiled for the
+    # (16, 4)-shaped wave instead of forking a fresh XLA variant. Pad
+    # lanes carry no seeds and no live assertions and are marked
+    # dead-on-arrival (callers slice verdicts back to n_real).
+    s_rows = _next_pow2(n_states) if CANONICAL_KEYS else n_states
+    if CANONICAL_KEYS:
+        max_v = _next_pow2(max_v)
+        max_a = _next_pow2(max_a)
+    seed_idx = np.full((s_rows, max_v), n, dtype=np.int32)
+    seed_lo = np.zeros((s_rows, max_v, bv256.NLIMBS), dtype=np.uint32)
+    seed_hi = np.zeros((s_rows, max_v, bv256.NLIMBS), dtype=np.uint32)
+    dead = np.zeros(s_rows, dtype=bool)
+    dead[n_states:] = True
     for s, bounds in enumerate(all_bounds):
         j = 0
         for var, lo, hi in bounds.values():
@@ -346,9 +369,8 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]],
                 seed_hi[s, j] = _word(hi)
                 j += 1
 
-    max_a = max((len(s) for s in assertion_sets), default=1) or 1
-    assert_idx = np.zeros((n_states, max_a), dtype=np.int32)
-    assert_mask = np.zeros((n_states, max_a), dtype=bool)
+    assert_idx = np.zeros((s_rows, max_a), dtype=np.int32)
+    assert_mask = np.zeros((s_rows, max_a), dtype=bool)
     for s, assts in enumerate(assertion_sets):
         for j, t in enumerate(assts):
             assert_idx[s, j] = index[t.tid]
@@ -358,6 +380,9 @@ def linearize(assertion_sets: Sequence[Sequence["T.Term"]],
         n, levels, jnp.asarray(init_lo), jnp.asarray(init_hi),
         jnp.asarray(seed_idx), jnp.asarray(seed_lo), jnp.asarray(seed_hi),
         dead, jnp.asarray(assert_idx), jnp.asarray(assert_mask),
+        n_real=n_states,
+        host=dict(terms=order, index=index, depth=depth, op=dev_op,
+                  args=args, mask=mask_w, aux=aux, n_slots=n_slots),
     )
 
 
@@ -375,8 +400,13 @@ def _smear(x):
     return x
 
 
-def _eval_level(level, lo_tab, hi_tab, ops_present):
-    """Evaluate one level's nodes vectorized over (state, node) axes.
+def _transfer_level(level, lo_tab, hi_tab, ops_present):
+    """Interval transfer for one level's nodes, vectorized over
+    (state, node): returns the level's (out_lo, out_hi) WITHOUT
+    scattering (NOP/pad rows carry their current table value through).
+    Shared by the plain forward evaluation below and the bidirectional
+    product-domain kernel (ops/propagate.py), which meets these
+    outputs against its refined tables instead of overwriting.
 
     `ops_present` is static: only the transfer functions for opcodes that
     actually occur in the level are traced, so small DAGs never pay the
@@ -575,7 +605,13 @@ def _eval_level(level, lo_tab, hi_tab, ops_present):
         m = (op == code)[None, :, None]
         out_lo = jnp.where(m, rlo, out_lo)
         out_hi = jnp.where(m, rhi, out_hi)
+    return out_lo, out_hi
 
+
+def _eval_level(level, lo_tab, hi_tab, ops_present):
+    """One forward level: transfer + scatter-overwrite into the tables."""
+    out_lo, out_hi = _transfer_level(level, lo_tab, hi_tab, ops_present)
+    node = level["node"]
     lo_tab = lo_tab.at[:, node].set(out_lo, mode="drop")
     hi_tab = hi_tab.at[:, node].set(out_hi, mode="drop")
     return lo_tab, hi_tab
@@ -635,7 +671,7 @@ def eval_feasible(enc: EncodedDAG) -> np.ndarray:
         _run_tables(enc))
     may_true = hi_tab[rows, jnp.asarray(assert_idx)][..., 0] != 0  # (S, A)
     ok = np.asarray(jnp.all(may_true | ~jnp.asarray(assert_mask), axis=1))
-    return ok[:n_states] & ~enc.dead
+    return (ok[:n_states] & ~enc.dead)[:enc.n_real]
 
 
 def eval_shadow(enc: EncodedDAG):
@@ -657,7 +693,7 @@ def eval_shadow(enc: EncodedDAG):
     may_true = hi_tab[rows, aidx][..., 0] != 0
     proved = np.asarray(jnp.all(~may_false | ~amask, axis=1))
     rejected = np.asarray(jnp.any(~may_true & amask, axis=1))
-    return proved[:n_states], rejected[:n_states]
+    return proved[:enc.n_real], rejected[:enc.n_real]
 
 
 def prefilter_feasible(assertion_sets) -> np.ndarray:

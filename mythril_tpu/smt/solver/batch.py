@@ -56,6 +56,46 @@ def tid_key(terms: Sequence["T.Term"]) -> tuple:
     return tuple(t.tid for t in terms)
 
 
+def _propagate_prescreen(norm, verdicts, registry, ss) -> None:
+    """Device product-domain propagation screen over the wave's still-
+    undecided queries (ops/propagate.py, MTPU_PROPAGATE): refuted lanes
+    verdict UNSAT before any solver work, and the surviving lanes'
+    harvested facts land in the run-wide verdict cache where
+    `_hints_for` asserts them ahead of the real constraints. Engaged
+    under the same gates as the device interval screen (lane config,
+    batch threshold, failure backoff); any verdict recorded here is a
+    sound refutation, so MTPU_PROPAGATE=0 changes cost, never
+    results."""
+    try:
+        from ...ops import propagate
+    except Exception:
+        return
+    if not propagate.enabled():
+        return
+    try:
+        undecided = [i for i, v in enumerate(verdicts) if v is None]
+        kills = propagate.prescreen(norm, undecided)
+    except (KeyboardInterrupt, MemoryError):
+        raise
+    except Exception as e:  # a screen, never an error path
+        log.debug("propagation prescreen failed: %s", e)
+        return
+    for i in kills:
+        verdicts[i] = UNSAT
+        registry.note_unsat(frozenset(t.tid for t in norm[i]))
+
+
+def _hints_for(vc, work) -> list:
+    """Harvested propagation facts for a query (implied consequences
+    of `work` — asserting them first cannot change the verdict)."""
+    if vc is None or not work:
+        return []
+    try:
+        return list(vc.facts_for(tid_key(work)))
+    except Exception:
+        return []
+
+
 def order_by_prefix(term_sets: Sequence[Sequence]) -> List[int]:
     """Indices in trie order: shortest set first, lexicographic by
     constraint tid within a length. A strict subset has strictly fewer
@@ -189,6 +229,8 @@ def _discharge_serial(
             work = []
         norm.append(work)
 
+    _propagate_prescreen(norm, verdicts, registry, ss)
+
     for i in order_by_prefix(norm):
         if verdicts[i] is not None:
             continue
@@ -235,8 +277,14 @@ def _discharge_serial(
                 pass
         ss.prefix_dedup_hits += count_prepared(work)
         ss.batch_solve_calls += 1
+        # harvested propagation facts assert FIRST: the core starts
+        # from the propagated state instead of rediscovering it
+        # (implied consequences — the verdict cannot change)
+        hints = _hints_for(vc, work)
+        if hints:
+            ss.bump(hinted_solves=1)
         try:
-            ctx = core.check(list(work), timeout_s=timeout_s,
+            ctx = core.check(hints + list(work), timeout_s=timeout_s,
                              conflict_budget=conflict_budget)
         except Exception as e:  # degraded, never wrong: keep the query
             log.debug("batch discharge solve failed: %s", e)
@@ -292,6 +340,8 @@ def _discharge_pooled(pool, term_sets, timeout_s, conflict_budget,
             verdicts[i] = UNSAT
             work = []
         norm.append(work)
+
+    _propagate_prescreen(norm, verdicts, registry, ss)
 
     vc = verdict_mod.cache()
     survivors: List[int] = []
@@ -358,8 +408,11 @@ def _discharge_pooled(pool, term_sets, timeout_s, conflict_budget,
             if hits:
                 ss.bump(affinity_prefix_hits=1, prefix_dedup_hits=hits)
             ss.bump(batch_solve_calls=1)
+            hints = _hints_for(vc, work)
+            if hints:
+                ss.bump(hinted_solves=1)
             try:
-                ctx = pool.solve_query(list(work), timeout_s,
+                ctx = pool.solve_query(hints + list(work), timeout_s,
                                        conflict_budget)
             except Exception as e:  # degraded, never wrong
                 log.debug("pooled discharge solve failed: %s", e)
@@ -412,8 +465,11 @@ def _serial_requery(i, norm, registry, vc, timeout_s, conflict_budget,
         ss.bump(sat_subsumed=1)
         return (SAT, None)
     ss.bump(batch_solve_calls=1)
+    hints = _hints_for(vc, work)
+    if hints:
+        ss.bump(hinted_solves=1)
     try:
-        ctx = core.check(list(work), timeout_s=timeout_s,
+        ctx = core.check(hints + list(work), timeout_s=timeout_s,
                          conflict_budget=conflict_budget)
     except Exception as e:
         log.debug("serial requery failed: %s", e)

@@ -93,6 +93,10 @@ _SHADOW_WALK = 16
 #: sibling-delta group size that routes shadow evaluation to the
 #: device interval kernel (host term-eval below it)
 DEVICE_SHADOW_MIN = 8
+#: harvested propagation-fact entries retained (LRU; ops/propagate.py
+#: writes them, batch.discharge / support/model.get_model assert them
+#: as hints ahead of the real constraints)
+_FACT_CAP = 4096
 
 
 class _Entry:
@@ -124,6 +128,10 @@ class VerdictCache:
         self._entries: "OrderedDict[frozenset, _Entry]" = OrderedDict()
         self._unsat_by_rep: Dict[int, List[frozenset]] = {}
         self._unsat_order: List[frozenset] = []
+        # harvested propagation facts per canonical key: implied
+        # consequences of the keyed set (docs/propagation.md), safe to
+        # assert ahead of its real constraints in any solver query
+        self._facts: "OrderedDict[frozenset, tuple]" = OrderedDict()
 
     # -- fingerprinting ----------------------------------------------------
 
@@ -203,6 +211,55 @@ class VerdictCache:
             e.model = model
         if verdict == UNSAT and index_unsat:
             self._index_unsat(ks)
+
+    # -- harvested propagation facts (ops/propagate.py) --------------------
+
+    @_locked
+    def note_facts(self, tids, facts: Sequence) -> None:
+        """Store learned facts (raw terms IMPLIED by the keyed set —
+        pinned constants, tightened bounds, forced bit masks the device
+        propagation pass derived). Asserting them ahead of the real
+        constraints cannot change a query's verdict or model set."""
+        if not ENABLED or not facts:
+            return
+        ks = self.key(tuple(tids))
+        if not ks:
+            return
+        self._facts[ks] = tuple(facts)
+        self._facts.move_to_end(ks)
+        while len(self._facts) > _FACT_CAP:
+            self._facts.popitem(last=False)
+
+    @_locked
+    def facts_for(self, tids) -> tuple:
+        """Harvested facts for an exact tid key (empty tuple when the
+        propagation pass has not screened this set)."""
+        got = self._facts.get(self.key(tuple(tids)))
+        if got is None:
+            return ()
+        return got
+
+    @_locked
+    def absorb_bounds(self, tids, bounds: Dict[int, tuple]) -> None:
+        """Meet propagated per-variable bounds into the entry's cached
+        bounds, so tier-3 interval inheritance (bounds_for) seeds
+        descendants from the PROPAGATED state instead of the raw
+        syntactic extraction."""
+        if not ENABLED or not bounds:
+            return
+        ks = self.key(tuple(tids))
+        if not ks:
+            return
+        e = self._ensure_entry(ks)
+        cur = dict(e.bounds) if e.bounds else {}
+        for var_tid, (var, lo, hi) in bounds.items():
+            old = cur.get(var_tid)
+            if old is None:
+                cur[var_tid] = (var, lo, hi)
+            else:
+                _, olo, ohi = old
+                cur[var_tid] = (var, max(lo, olo), min(hi, ohi))
+        e.bounds = cur
 
     # -- tier 1: ancestor-UNSAT subsumption --------------------------------
 
