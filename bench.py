@@ -1107,10 +1107,176 @@ def _smoke_propagate():
     return result
 
 
+def build_diamond_contract(k=6, dup_levels=2, tail=True):
+    """k gas- AND step-balanced CFG diamonds (a fork storm of rejoining
+    paths): level i forks on a calldata bit, both arms execute the SAME
+    instruction count and gas (JUMPDEST, PUSH2 R, JUMP on each side),
+    and rejoin at R with identical stack/memory/storage — the
+    exact-frontier-twin shape the window merge pass collapses. The
+    first `dup_levels` levels re-test BIT 0 (the re-tested condition
+    interns to one tid, so `{c}`-vs-`{c,¬c}` superset subsumption
+    provably fires), the rest fork on distinct bits. The optional tail
+    forks on calldata word 31 == 0xdeadbeef into an INVALID (one
+    reachable Exception State issue for identity gating)."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray()
+    for i in range(k):
+        bit = 0 if i < dup_levels else i
+        c += push(bit) + bytes([op["CALLDATALOAD"]])
+        c += push(1) + bytes([op["AND"]])
+        j = len(c)
+        c += push(0, 2) + bytes([op["JUMPI"]])
+        # fall arm: JUMPDEST (step/gas balance), PUSH2 R, JUMP
+        c += bytes([op["JUMPDEST"]])
+        jf = len(c)
+        c += push(0, 2) + bytes([op["JUMP"]])
+        t = len(c)
+        c[j + 1:j + 3] = t.to_bytes(2, "big")
+        # taken arm: JUMPDEST, PUSH2 R, JUMP — same 3 steps, 12 gas
+        c += bytes([op["JUMPDEST"]])
+        jt = len(c)
+        c += push(0, 2) + bytes([op["JUMP"]])
+        r = len(c)
+        c[jf + 1:jf + 3] = r.to_bytes(2, "big")
+        c[jt + 1:jt + 3] = r.to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"]])
+    if tail:
+        c += push(31) + bytes([op["CALLDATALOAD"]])
+        c += push(0xDEADBEEF, 4) + bytes([op["EQ"]])
+        j = len(c)
+        c += push(0, 2) + bytes([op["JUMPI"]])
+        c += bytes([op["STOP"]])
+        t = len(c)
+        c[j + 1:j + 3] = t.to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"], 0xFE])  # INVALID: assert-style
+    else:
+        c += bytes([op["STOP"]])
+    return bytes(c)
+
+
+def _smoke_merge():
+    """Stage 7: the lane-merge / path-subsumption gate
+    (docs/lane_merge.md).
+
+    A rigged diamond-CFG fork storm (build_diamond_contract) runs
+    through the REAL window drain twice at each seam:
+
+    * LANE seam (window-boundary merge, tpu_lanes=64, 32-step windows
+      so boundaries land mid-storm): with merge on, gates nonzero
+      ``lanes_merged`` AND nonzero ``lanes_subsumed`` (the duplicated
+      level makes superset subsumption provable), a post-merge
+      live-lane/parked count STRICTLY below the unmerged run, and an
+      issue set identical to ``MTPU_MERGE=0``;
+    * HOST seam (svm round-boundary open-state merge, tpu_lanes=0,
+      2 transactions): gates nonzero merged states, fewer open-state
+      screen queries than the unmerged run, and issue identity.
+
+    Wall-clock is NOT gated (single-CPU container constraint): the
+    evidence is avoided-work counters and collapsed state counts."""
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.laser import merge as merge_mod
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.analysis_args import make_cmd_args
+
+    code = build_diamond_contract(k=6, dup_levels=2)
+    ss = SolverStatistics()
+
+    def analyze(merge_on, tpu_lanes, tx_count):
+        merge_mod.FORCE = merge_on
+        try:
+            reset_analysis_state()
+            c0 = dict(ss.batch_counters())
+            lane_engine.RUN_STATS_TOTAL = {}
+            dis = MythrilDisassembler(eth=None)
+            address, _ = dis.load_from_bytecode(code.hex(),
+                                                bin_runtime=True)
+            analyzer = MythrilAnalyzer(
+                disassembler=dis,
+                cmd_args=make_cmd_args(execution_timeout=120,
+                                       tpu_lanes=tpu_lanes),
+                strategy="bfs", address=address)
+            report = analyzer.fire_lasers(modules=None,
+                                          transaction_count=tx_count)
+            c1 = ss.batch_counters()
+            eng = dict(lane_engine.RUN_STATS_TOTAL)
+            return {
+                "issues": sorted((i.swc_id, i.address, i.title)
+                                 for i in report.issues.values()),
+                "counters": {k: round(c1[k] - c0.get(k, 0), 1)
+                             for k in ("lanes_merged", "lanes_subsumed",
+                                       "merge_rounds", "or_terms_built",
+                                       "batch_queries")},
+                "parked": eng.get("parked", 0),
+            }
+        finally:
+            merge_mod.FORCE = None
+
+    lane_engine.PATH_HISTORY[code] = 64
+    lane_engine.FORCE_WIDTH = 64
+    old_window = lane_engine.DEFAULT_WINDOW
+    lane_engine.DEFAULT_WINDOW = 32
+    try:
+        lane_engine.warm_variant(
+            64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        lane_off = analyze(False, 64, 1)
+        lane_on = analyze(True, 64, 1)
+    finally:
+        lane_engine.FORCE_WIDTH = None
+        lane_engine.DEFAULT_WINDOW = old_window
+    host_off = analyze(False, 0, 2)
+    host_on = analyze(True, 0, 2)
+
+    lc = lane_on["counters"]
+    hc = host_on["counters"]
+    result = {
+        "lane": {
+            "lanes_merged": lc["lanes_merged"],
+            "lanes_subsumed": lc["lanes_subsumed"],
+            "or_terms_built": lc["or_terms_built"],
+            "parked": {"merge_off": lane_off["parked"],
+                       "merge_on": lane_on["parked"]},
+            "issues_identical": lane_on["issues"] == lane_off["issues"],
+        },
+        "host": {
+            "states_merged": hc["lanes_merged"] + hc["lanes_subsumed"],
+            "screen_queries": {"merge_off": host_off["counters"]
+                               ["batch_queries"],
+                               "merge_on": hc["batch_queries"]},
+            "issues_identical": host_on["issues"] == host_off["issues"],
+        },
+        "issues": lane_on["issues"],
+    }
+    result["ok"] = bool(
+        lc["lanes_merged"] > 0
+        and lc["lanes_subsumed"] > 0
+        and lane_on["parked"] < lane_off["parked"]
+        and result["lane"]["issues_identical"]
+        and result["host"]["states_merged"] > 0
+        and hc["batch_queries"]
+        < host_off["counters"]["batch_queries"]
+        and result["host"]["issues_identical"]
+        and len(lane_on["issues"]) > 0
+    )
+    return result
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Six stages:
+    run-wide verdict cache — NO full corpus sweep. Seven stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -1147,7 +1313,13 @@ def bench_smoke():
        a randomized SAT-preservation spot check. Any miss exits 1.
        Stages 1-5 run BEFORE it at the default device config
        (tpu_lanes auto -> 0 on CI CPU boxes), so their results stay
-       byte-identical to the pre-propagation build.
+       byte-identical to the pre-propagation build;
+    7. the lane-merge gate (_smoke_merge, docs/lane_merge.md): a
+       rigged diamond-CFG fork storm through the REAL window drain —
+       nonzero lanes_merged AND lanes_subsumed, post-merge live-lane
+       count strictly below the MTPU_MERGE=0 run, open-state screen
+       queries saved at the svm round boundary, and issue-set identity
+       with merge on vs off at both seams. Any miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -1294,6 +1466,20 @@ def bench_smoke():
     else:
         out["propagate"] = {"skipped": True, "ok": True}
 
+    # stage 7: the lane-merge / path-subsumption gate (rigged diamond-
+    # CFG fork storm through the real window drain AND the svm round
+    # boundary: merge/subsume counters, collapsed live-lane counts,
+    # issue identity vs MTPU_MERGE=0; skippable for the quick inner
+    # loop via MTPU_SMOKE_MERGE=0)
+    if os.environ.get("MTPU_SMOKE_MERGE", "1") != "0":
+        try:
+            out["merge"] = _smoke_merge()
+        except Exception as e:
+            out["merge"] = {"ok": False, "error": type(e).__name__,
+                            "detail": str(e)[:200]}
+    else:
+        out["merge"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -1316,7 +1502,12 @@ def bench_smoke():
           and out["pool"].get("ok", False)
           # the propagation gate: rigged-mix kills, fact harvest,
           # hinted solves, interval-only parity, SAT preservation
-          and out["propagate"].get("ok", False))
+          and out["propagate"].get("ok", False)
+          # the merge gate: lanes merged AND subsumed on the diamond
+          # storm, post-merge live-lane count strictly below the
+          # unmerged run, open-state screen queries saved, and issue
+          # identity vs MTPU_MERGE=0 at both seams
+          and out["merge"].get("ok", False))
     return 0 if ok else 1
 
 

@@ -688,6 +688,134 @@ def _remap_reset_core(st: SymLaneState, prov_pairs) -> SymLaneState:
     )
 
 
+def _sm32(x):
+    """splitmix32 finisher: per-column pseudorandom multipliers for the
+    lane-fingerprint folds."""
+    x = (x + jnp.uint32(0x9E3779B9)).astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+@jax.jit
+def _merge_fingerprint(st: SymLaneState, prov_pairs):
+    """Per-lane FRONTIER fingerprint for the window-boundary merge pass
+    (docs/lane_merge.md): the lane-dedup extension of the _dedup_canon/
+    _unique_table record-dedup machinery. Folds everything a lane's
+    future execution (and its materialization) can read — pc, depth,
+    fork group, fentry, gas interval, the live stack (canonical sids +
+    concrete limbs), memory bytes + overlay records, the storage slot
+    table with write-ORDER ranks (absolute s_wstep values differ between
+    gas-balanced rejoin arms and must not block a merge), and the
+    calldata/env shape scalars — into two independent 32-bit
+    multilinear hashes. Provisional (negative) sids from the window
+    just drained remap through the same sparse resolution pairs the
+    next dispatch will apply, so record identity is canonical across
+    lanes. Deliberately EXCLUDED: steps (budget accounting), status,
+    the drained dlog/flog planes, and last_jump (which jump entered a
+    rejoin differs per disjunct; the survivor's value represents one
+    witness path). Equal fingerprints + equal host context
+    (template/swrites/promos) define an exact-frontier twin group.
+
+    Returns (N, 2) uint32."""
+    n = st.pc.shape[0]
+    d_recs = st.dlog_op.shape[1]
+    dense = jnp.full((n * d_recs,), np.iinfo(np.int32).min, jnp.int32)
+    dense = dense.at[prov_pairs[:, 0]].set(prov_pairs[:, 1],
+                                           mode="drop")
+    prov_arr = dense.reshape(n, d_recs)
+
+    def remap(plane):
+        negm = plane < 0
+        idx = jnp.where(negm, -plane - 1, 0)
+        mapped = prov_arr[idx // d_recs, idx % d_recs]
+        return jnp.where(negm, mapped, plane)
+
+    h1 = jnp.full((n,), 2166136261, jnp.uint32)
+    h2 = jnp.full((n,), 0x9E3779B9, jnp.uint32)
+    seed = [0]
+
+    def fold(h1, h2, arr, mask=None):
+        seed[0] += 1
+        arr = arr.reshape(n, -1).astype(jnp.uint32)
+        if mask is not None:
+            arr = jnp.where(mask.reshape(n, -1), arr, 0)
+            # the mask pattern itself is part of the frontier only
+            # through planes that are folded separately (sp, counts,
+            # sid planes), so masked slots contribute exactly 0
+        k = arr.shape[1]
+        idx = (jnp.arange(k, dtype=jnp.uint32)
+               + jnp.uint32((seed[0] * 0x632BE59B) & 0xFFFFFFFF))
+        w1 = _sm32(idx)
+        w2 = _sm32(idx ^ jnp.uint32(0x7F4A7C15))
+        s1 = jnp.sum(arr * w1[None, :], axis=1, dtype=jnp.uint32)
+        s2 = jnp.sum((arr ^ w2[None, :]) * w1[None, :], axis=1,
+                     dtype=jnp.uint32)
+        h1 = (h1 ^ s1) * jnp.uint32(16777619)
+        h2 = (h2 + s2) * jnp.uint32(2654435761)
+        h2 = h2 ^ (h2 >> 15)
+        return h1, h2
+
+    for scalar in (st.pc, st.sp, st.depth, st.group, st.fentry,
+                   st.msize, st.mlog_count, st.scount, st.s_mode,
+                   st.sbase, st.cd_size, st.cd_sym, st.cd_size_sid,
+                   st.min_gas, st.max_gas, st.gas_limit):
+        h1, h2 = fold(h1, h2, scalar)
+
+    depth_cap = st.stack.shape[1]
+    slot_live = jnp.arange(depth_cap)[None, :] < st.sp[:, None]
+    ssid_r = remap(st.ssid)
+    h1, h2 = fold(h1, h2, ssid_r, slot_live)
+    conc = slot_live & (ssid_r == 0)
+    h1, h2 = fold(h1, h2, st.stack,
+                  jnp.repeat(conc, bv256.NLIMBS, axis=1))
+
+    # memory: the kind plane in full; byte content only where a
+    # concrete byte/word actually lives (symbolic-word bytes are stale
+    # — their content is the overlay log, folded below)
+    h1, h2 = fold(h1, h2, st.mkind)
+    conc_mem = (st.mkind != 0) & (st.mkind != symstep.KIND_SYM_WORD)
+    h1, h2 = fold(h1, h2, st.memory, conc_mem)
+    mr = st.mlog_off.shape[1]
+    mlog_live = jnp.arange(mr)[None, :] < st.mlog_count[:, None]
+    h1, h2 = fold(h1, h2, st.mlog_off, mlog_live)
+    h1, h2 = fold(h1, h2, st.mlog_len, mlog_live)
+    h1, h2 = fold(h1, h2, remap(st.mlog_sid), mlog_live)
+
+    # storage slot table: keys/values by canonical sid or limbs, the
+    # read/write flags, and the write ORDER as a rank (not the raw
+    # step stamp)
+    s_slots = st.skeys.shape[1]
+    srow = jnp.arange(s_slots)[None, :] < st.scount[:, None]
+    skey_r = remap(st.skey_sid)
+    sval_r = remap(st.sval_sid)
+    h1, h2 = fold(h1, h2, skey_r, srow)
+    h1, h2 = fold(h1, h2, sval_r, srow)
+    h1, h2 = fold(h1, h2, st.s_written, srow)
+    h1, h2 = fold(h1, h2, st.s_read, srow)
+    h1, h2 = fold(h1, h2, st.skeys,
+                  jnp.repeat(srow & (skey_r == 0), bv256.NLIMBS,
+                             axis=1))
+    h1, h2 = fold(h1, h2, st.svals,
+                  jnp.repeat(srow & (sval_r == 0), bv256.NLIMBS,
+                             axis=1))
+    written = srow & (st.s_written != 0)
+    ws = jnp.where(written, st.s_wstep, np.iinfo(np.int32).max)
+    # rank of each written slot among the lane's writes (stable by
+    # slot index for equal stamps)
+    earlier = (ws[:, :, None] > ws[:, None, :]) | (
+        (ws[:, :, None] == ws[:, None, :])
+        & (jnp.arange(s_slots)[None, :, None]
+           > jnp.arange(s_slots)[None, None, :]))
+    rank = jnp.sum(earlier & written[:, None, :], axis=2,
+                   dtype=jnp.int32)
+    h1, h2 = fold(h1, h2, jnp.where(written, rank, -1))
+
+    return jnp.stack([h1, h2], axis=1)
+
+
 #: fast-retire row budget and column floors (stack slots, memory bytes,
 #: memory-overlay records, storage slots) for the in-dispatch retire
 #: gather; lanes over a floor (or past the row budget) stay NEEDS_HOST
@@ -1403,6 +1531,8 @@ class LaneEngine:
             "overlap_idle_ms": 0, "overlap_busy_ms": 0,
             "device_wait_ms": 0, "overlap_solve_ms": 0,
             "fork_screened": 0, "fork_killed": 0,
+            # window-boundary merge/subsume pass (docs/lane_merge.md)
+            "lanes_merged": 0, "lanes_subsumed": 0, "merge_rounds": 0,
         }
         # in-place SHA3 resume: off whenever a detector hooks SHA3
         # (the hook must fire host-side; no adapter lifts SHA3 today)
@@ -2397,6 +2527,115 @@ class LaneEngine:
         return [lane for (lane, _), v in zip(queries, verdicts)
                 if v == solver_batch.UNSAT]
 
+    # -- window-boundary lane merge / subsumption ----------------------------
+
+    def _window_merge(self, st, status, ctxs, dead_set, kill,
+                      counts_h, resumes) -> None:
+        """Collapse exact-frontier twin lanes at the window boundary
+        (docs/lane_merge.md). Runs AFTER the drain (canonical sids and
+        this window's conds are final) and BEFORE the next dispatch's
+        kill list closes, so a retired lane never executes another
+        step. Cheap host pre-grouping (pc/sp/counters/template/write
+        mirror) decides whether the device fingerprint dispatch is
+        worth issuing at all; groups that survive the full fingerprint
+        hand their condition lists to merge.plan_group — duplicates and
+        implied siblings retire subsumed, the incomparable rest merges
+        into one lane under an OR'd suffix with disjunct provenance.
+        Gated by MTPU_MERGE (default on). Mesh-safe: the fingerprint
+        kernel is row-parallel over the sharded lane axis (elementwise
+        folds + per-lane reductions; the prov table and pair inputs
+        stay replicated), so unlike the full-plane seed scatters (see
+        pick_mesh) it partitions cleanly — and any kernel failure is
+        caught below and skips the pass, never the window."""
+        from . import merge as merge_mod
+
+        if not merge_mod.enabled():
+            return
+        excluded = dead_set | set(kill) | {r[0] for r in resumes}
+        pcs, sps = counts_h["pc"], counts_h["sp"]
+        pre: Dict[tuple, List[int]] = {}
+        for lane in range(self.n_lanes):
+            ctx = ctxs[lane]
+            if (ctx is None or lane in excluded
+                    or status[lane] != Status.RUNNING):
+                continue
+            if ctx.promos:
+                continue  # adapter sink promotions are per-path
+            key = (
+                id(ctx.template), int(pcs[lane]), int(sps[lane]),
+                int(counts_h["msize"][lane]),
+                int(counts_h["scount"][lane]),
+                int(counts_h["mlog_count"][lane]),
+                tuple((k.raw.tid, v.raw.tid) for k, v in ctx.swrites),
+            )
+            pre.setdefault(key, []).append(lane)
+        if not any(len(v) > 1 for v in pre.values()):
+            return
+        d_recs = self.lane_kwargs.get("dlog_records", 64)
+        n = self.n_lanes
+        pv = min(PROV_BUCKET, n * d_recs) \
+            if len(self._prov) <= PROV_BUCKET else n * d_recs
+        prov_pairs = np.full((pv, 2), n * d_recs, np.int32)
+        for j, ((lane, slot), oid) in enumerate(self._prov.items()):
+            prov_pairs[j, 0] = lane * d_recs + slot
+            prov_pairs[j, 1] = oid
+        try:
+            with _prof("merge_fp"):
+                fp = np.asarray(jax.device_get(_merge_fingerprint(
+                    st, jnp.asarray(prov_pairs))))
+        except Exception as e:  # a screen, never an error path
+            log.debug("merge fingerprint failed: %s", e)
+            return
+        merged = subsumed = 0
+        for key, lanes in pre.items():
+            if len(lanes) < 2:
+                continue
+            twins: Dict[tuple, List[int]] = {}
+            for lane in lanes:
+                twins.setdefault(
+                    (int(fp[lane, 0]), int(fp[lane, 1])), []
+                ).append(lane)
+            for group in twins.values():
+                if len(group) < 2:
+                    continue
+                cond_lists = [[c for (_s, c) in ctxs[g].conds]
+                              for g in group]
+                try:
+                    plan = merge_mod.plan_group(cond_lists)
+                except Exception:
+                    log.debug("merge planning failed", exc_info=True)
+                    continue
+                if plan is None:
+                    continue
+                survivor = group[plan.keep]
+                if plan.new_conds is not None:
+                    sc = ctxs[survivor].conds
+                    stamp = max((cl[-1][0] for cl in
+                                 (ctxs[g].conds for g in group) if cl),
+                                default=0)
+                    ctxs[survivor].conds = (
+                        sc[:plan.prefix_len]
+                        + [(stamp, c)
+                           for c in plan.new_conds[plan.prefix_len:]])
+                for mi, reason in plan.dropped.items():
+                    kill.append(group[mi])
+                    if reason == "merged":
+                        merged += 1
+                    else:
+                        subsumed += 1
+        if merged or subsumed:
+            self.stats["lanes_merged"] += merged
+            self.stats["lanes_subsumed"] += subsumed
+            self.stats["merge_rounds"] += 1
+            from ..smt.solver.solver_statistics import SolverStatistics
+
+            SolverStatistics().bump(
+                lanes_merged=merged, lanes_subsumed=subsumed,
+                merge_rounds=1)
+            merge_mod.note_retired(merged + subsumed)
+            log.info("lane merge: %d merged, %d subsumed at window "
+                     "boundary", merged, subsumed)
+
     # -- top-level loop ------------------------------------------------------
 
     def explore(self, code_bytes: bytes,
@@ -2857,6 +3096,15 @@ class LaneEngine:
                         kill.append(lane)
                         self.stats["fork_killed"] += 1
                 screen_dead = []
+                # window-boundary lane merge/subsume (MTPU_MERGE,
+                # docs/lane_merge.md): exact-frontier twins collapse
+                # under an OR'd constraint suffix, implied siblings
+                # retire subsumed — their kills ride the next dispatch
+                # (same protocol as trivially-false lanes), BEFORE that
+                # window executes, so a merged-away lane never runs
+                # another step
+                self._window_merge(st, status, ctxs, dead_set, kill,
+                                   counts_h, resumes)
                 # collect the NEXT overlapped screen batch: lanes that
                 # gained path conditions this window and are still
                 # running (their descendants subset-kill through the

@@ -114,6 +114,20 @@ class BenchmarkPlugin(LaserPlugin):
                     counters["facts_harvested"],
                     counters["hinted_solves"],
                 )
+            # window/round-boundary lane merge (docs/lane_merge.md):
+            # exact-frontier twins collapsed under OR'd suffixes,
+            # siblings retired by subsumption, and the passes/OR terms
+            # that did it
+            if counters["lanes_merged"] or \
+                    counters["lanes_subsumed"]:
+                log.info(
+                    "Lane merge: merged=%d subsumed=%d rounds=%d "
+                    "or_terms=%d",
+                    counters["lanes_merged"],
+                    counters["lanes_subsumed"],
+                    counters["merge_rounds"],
+                    counters["or_terms_built"],
+                )
             # persistent solver pool (docs/solver_pool.md): worker
             # count, pooled queries, portfolio races (and which tactic
             # won them), affinity hits, deaths, and the solver wall

@@ -104,6 +104,17 @@ class InstructionProfiler(LaserPlugin):
                         counters["facts_harvested"],
                         counters["hinted_solves"],
                     ))
+            # window/round-boundary lane merge (docs/lane_merge.md)
+            if counters["lanes_merged"] or \
+                    counters["lanes_subsumed"]:
+                lines.append(
+                    "Lane merge: merged={} subsumed={} rounds={} "
+                    "or_terms={}".format(
+                        counters["lanes_merged"],
+                        counters["lanes_subsumed"],
+                        counters["merge_rounds"],
+                        counters["or_terms_built"],
+                    ))
             # persistent solver pool (docs/solver_pool.md)
             if counters["pool_workers"] > 1 or \
                     counters["queries_pooled"]:

@@ -280,6 +280,22 @@ class LaserEVM:
             execute_message_call(self, address, func_hashes=func_hashes)
             for hook in self._stop_sym_trans_hooks:
                 hook()
+            # round-boundary open-state merge (laser/merge.py,
+            # MTPU_MERGE): the drained worklist collapses exact-
+            # frontier twins under an OR'd constraint suffix and
+            # retires implied siblings BEFORE the next round re-seeds
+            # from it — fewer states to screen, solve and execute.
+            # Final-round states are left untouched (nothing re-seeds
+            # from them).
+            if i + 1 < self.transaction_count and \
+                    len(self.open_states) > 1:
+                try:
+                    from .merge import merge_open_states
+
+                    self.open_states = merge_open_states(
+                        self.open_states)
+                except Exception as e:  # a screen, never an error path
+                    log.debug("open-state merge failed: %s", e)
             if (self.use_reachability_check
                     and i + 1 < self.transaction_count):
                 # fully-async feasibility seam: round i+1's open-state

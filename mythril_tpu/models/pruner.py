@@ -85,7 +85,12 @@ _MAX_SKIP = 256
 #: diagnostics): items screened through the interval domain, items
 #: pruned by it, and how many ran on the device vs host transfer
 #: functions.
-STATS = {"screened": 0, "pruned": 0, "device_screened": 0}
+STATS = {"screened": 0, "pruned": 0, "device_screened": 0,
+         # states/lanes the merge pass (laser/merge.py) retired BEFORE
+         # they could reach this screen: every one is a whole
+         # constraint system that never costs an interval row, a
+         # device dispatch slot, or a solver query here
+         "merge_retired": 0}
 
 
 def _device_should_try() -> bool:

@@ -1025,6 +1025,57 @@ def prefilter_feasible(assertion_sets: Sequence[Sequence]) -> np.ndarray:
     return keep
 
 
+def abstraction_sets(assertion_sets: Sequence[Sequence]
+                     ) -> Optional[List[Optional[Dict[int, tuple]]]]:
+    """Per-set variable abstractions from the product-domain fixpoint:
+    ``{var_tid: (lo, hi, k0, k1)}`` for every free BV variable of each
+    assertion set, with the interval<->known-bits exchange already
+    applied. A set the fixpoint refutes maps to ``None`` (bottom).
+    Returns ``None`` when the plan falls outside the kernel envelope —
+    callers fall back to host bounds (the lane-merge subsumption tier,
+    laser/merge.py, falls back to the verdict cache's tier-3 bounds,
+    which absorb these same tables when the fork screen ran)."""
+    sets = [[getattr(t, "raw", t) for t in s] for s in assertion_sets]
+    enc = linearize(sets)
+    got = run(enc)
+    if got is None:
+        return None
+    keep, (lo, hi, k0, k1), _sweeps = got
+    order = enc.host["terms"]
+    var_rows = [i for i, t in enumerate(order)
+                if t.op == T.BV_VAR and isinstance(t.width, int)
+                and 1 <= t.width <= 256]
+    if not var_rows:
+        return [None if not keep[s] else {}
+                for s in range(enc.n_real)]
+    vi = jnp.asarray(np.asarray(var_rows, dtype=np.int32))
+    vlo = _limbs_to_ints(np.asarray(lo[:, vi]))
+    vhi = _limbs_to_ints(np.asarray(hi[:, vi]))
+    vk0 = _limbs_to_ints(np.asarray(k0[:, vi]))
+    vk1 = _limbs_to_ints(np.asarray(k1[:, vi]))
+    out: List[Optional[Dict[int, tuple]]] = []
+    for s in range(enc.n_real):
+        if not keep[s]:
+            out.append(None)
+            continue
+        support = set()
+        for t in _state_terms(enc, s):
+            support |= _free_bv_vars(t)
+        d: Dict[int, tuple] = {}
+        for j, r in enumerate(var_rows):
+            t = order[r]
+            if t.tid not in support:
+                continue
+            lo_i, hi_i = int(vlo[s, j]), int(vhi[s, j])
+            k0_i, k1_i = int(vk0[s, j]), int(vk1[s, j])
+            if lo_i > hi_i or (k0_i & k1_i):
+                d = None  # contradictory row missed by the verdict
+                break
+            d[t.tid] = (lo_i, hi_i, k0_i, k1_i)
+        out.append(d)
+    return out
+
+
 def prescreen(term_sets: Sequence[Sequence], undecided: Sequence[int]
               ) -> Dict[int, bool]:
     """{query index: False} kills for a discharge/check_batch wave,

@@ -461,9 +461,10 @@ class VerdictCache:
 
     @_locked
     def export_entries(self, term_lists: Sequence[Sequence]) -> List:
-        """Cached proofs restricted to the given states' constraint
-        prefixes, as ``(ordered terms, verdict, model)`` triples ready
-        for term-safe pickling (support/checkpoint.py sidecars).
+        """Cached proofs AND harvested propagation banks restricted to
+        the given states' constraint prefixes, as ``(ordered terms,
+        verdict, model, facts, bounds)`` tuples ready for term-safe
+        pickling (support/checkpoint.py sidecars).
 
         For each normalized raw-term list this collects the exact-key
         entry, every cached ordered-prefix entry (both discharge
@@ -472,8 +473,25 @@ class VerdictCache:
         state's tid-set. Terms ship as objects — the thief re-interns
         them into its own table, so the fingerprints re-derive there
         (tids are process-local). Models ship as slim copies (the
-        eval memos and env caches stay home)."""
+        eval memos and env caches stay home). ``facts`` are the
+        note_facts bank (raw implied terms from ops/propagate.py) and
+        ``bounds`` the absorb_bounds bank as ``(var term, lo, hi)``
+        triples — shipping them means a thief asserts the victim's
+        propagated facts as solver hints and seeds tier-3 screens from
+        the propagated bounds instead of re-deriving both on device.
+        A prefix with ONLY banked facts/bounds (no verdict yet) ships
+        with verdict None."""
         out: Dict[frozenset, tuple] = {}
+
+        def _banks(pk):
+            facts = self._facts.get(pk, ())
+            e = self._entries.get(pk)
+            bounds = ()
+            if e is not None and e.bounds:
+                bounds = tuple((var, lo, hi)
+                               for var, lo, hi in e.bounds.values())
+            return tuple(facts), bounds
+
         for terms in term_lists:
             terms = list(terms)
             if not terms:
@@ -491,34 +509,54 @@ class VerdictCache:
                 if pk is None or pk in out:
                     continue
                 e = self._entries.get(pk)
-                if e is None or e.verdict not in (SAT, UNSAT):
+                verdict = e.verdict if e is not None \
+                    and e.verdict in (SAT, UNSAT) else None
+                facts, bounds = _banks(pk)
+                if verdict is None and not facts and not bounds:
                     continue
                 seen = set()
                 ordered = [by_tid[t] for t in ptids
                            if t in pk and not (t in seen or seen.add(t))]
-                out[pk] = (ordered, e.verdict, _slim_model(e.model))
+                out[pk] = (ordered, verdict,
+                           _slim_model(e.model) if e is not None
+                           else None, facts, bounds)
             ks = frozenset(tids)
             for t in ks:
                 for u in self._unsat_by_rep.get(t, ()):
                     if u not in out and u <= ks:
+                        facts, bounds = _banks(u)
                         out[u] = ([by_tid[x] for x in sorted(u)],
-                                  UNSAT, None)
+                                  UNSAT, None, facts, bounds)
         entries = list(out.values())
         SolverStatistics().verdicts_shipped += len(entries)
         return entries
 
     @_locked
     def import_entries(self, entries: Sequence) -> int:
-        """Record shipped proofs under THIS process's term table (the
-        terms re-interned on load carry this table's tids). Returns the
-        number of entries replayed; counted in verdicts_replayed."""
+        """Record shipped proofs — and replay shipped propagation-fact/
+        bound banks — under THIS process's term table (the terms
+        re-interned on load carry this table's tids). Accepts both the
+        5-tuple format and legacy ``(terms, verdict, model)`` triples.
+        Returns the number of entries replayed; counted in
+        verdicts_replayed."""
         if not ENABLED:
             return 0
         n = 0
-        for terms, verdict, model in entries:
+        for entry in entries:
             try:
-                self.record(tuple(t.tid for t in terms), verdict,
-                            model=model)
+                terms, verdict, model = entry[0], entry[1], entry[2]
+                facts = entry[3] if len(entry) > 3 else ()
+                bounds = entry[4] if len(entry) > 4 else ()
+                tids = tuple(t.tid for t in terms)
+                if verdict in (SAT, UNSAT):
+                    self.record(tids, verdict, model=model)
+                if facts:
+                    self.note_facts(tids, facts)
+                if bounds:
+                    self.absorb_bounds(
+                        tids,
+                        {var.tid: (var, lo, hi)
+                         for var, lo, hi in bounds})
                 n += 1
             except Exception:  # a cache, never an error path
                 log.debug("verdict import skipped one entry",

@@ -665,6 +665,12 @@ def mk_bool_and(*args: Term) -> Term:
         if a.tid not in seen:
             seen.add(a.tid)
             uniq.append(a)
+    # complementary literals annihilate: and(..., a, not(a), ...) is
+    # FALSE (lane-merge OR terms and re-tested branch conditions build
+    # exactly this shape; the fold keeps them out of every screen)
+    for a in uniq:
+        if a.op == NOT and a.args[0].tid in seen:
+            return _FALSE
     if not uniq:
         return _TRUE
     if len(uniq) == 1:
@@ -689,6 +695,12 @@ def mk_bool_or(*args: Term) -> Term:
         if a.tid not in seen:
             seen.add(a.tid)
             uniq.append(a)
+    # complementary literals saturate: or(..., a, not(a), ...) is TRUE
+    # (a fully-rejoined CFG diamond's merged constraint collapses to
+    # no constraint at all — Constraints.append then drops it)
+    for a in uniq:
+        if a.op == NOT and a.args[0].tid in seen:
+            return _TRUE
     if not uniq:
         return _FALSE
     if len(uniq) == 1:

@@ -231,7 +231,15 @@ def test_verdict_sidecar_roundtrip(tmp_path):
     # constraint lists (incl. the keccak-axiom tail)
     term_lists = [[c.raw for c in s.get_all_constraints()]
                   for s in (sat_set, unsat_set)]
+    # PR-5 harvested banks ride the sidecar too: bank a propagated
+    # fact and a tightened bound for the SAT set on the victim
+    sat_tids = tuple(t.tid for t in term_lists[0])
+    fact = ULE(x, bv(100)).raw
+    vc.note_facts(sat_tids, (fact,))
+    vc.absorb_bounds(sat_tids, {x.raw.tid: (x.raw, 5, 100)})
     entries = vc.export_entries(term_lists)
+    assert any(len(e) > 3 and (e[3] or e[4]) for e in entries), \
+        "no facts/bounds exported"
     assert entries, "nothing exported"
     side = tmp_path / "batch.verdicts"
     assert save_verdict_sidecar(side, entries)
@@ -258,4 +266,15 @@ def test_verdict_sidecar_roundtrip(tmp_path):
     assert ss.batch_counters()["queries_saved"] > saved0
     # and the shipped model is a usable assignment
     assert model is not None
+    # the harvested banks replayed too: the thief asserts the victim's
+    # propagated facts as hints and seeds tier-3 from its bounds
+    # without re-deriving either on device
+    thief_tids = tuple(t.tid for t in term_lists[0])
+    assert fact in thief.facts_for(thief_tids)
+    bounds = thief.bounds_for(term_lists[0], thief_tids)
+    assert bounds[x.raw.tid][1] >= 5 and bounds[x.raw.tid][2] <= 100
+    # legacy 3-tuple sidecars still import (mixed-version fleet)
+    n = thief.import_entries([(list(term_lists[1]), verdicts.UNSAT,
+                               None)])
+    assert n == 1
     verdicts.reset_cache()
