@@ -696,6 +696,50 @@ def bench_config4(timeout=60, lanes=4096):
             pass
 
     host_walls, host_issues, host_total, host_errors = _sweep(0)
+    # second warm stage, AFTER the host sweep: the host run just
+    # recorded each contract's fork peak (svm._record_fork_scale ->
+    # PATH_HISTORY), which pick_width uses to right-size the lane
+    # sweep's engines. Any width it will now select outside the static
+    # (64, lanes) pair above cold-compiles its fused-window variant
+    # ~40 s INSIDE that contract's timed region (BENCH_r06:
+    # ether_send.sol.o 46 s lane vs 4.2 s host, reproduced pre-PR-6 —
+    # the reduced stage set no longer pre-warmed it via config 5).
+    # Steady-state measurement intent unchanged: a CLI user pays the
+    # compile once per shape via the persistent cache.
+    codes = {}
+    for p in fixtures:
+        try:
+            codes[p] = bytes.fromhex(
+                p.read_text().strip().replace("0x", ""))
+        except ValueError:
+            continue
+    warm_pairs = set()
+    for code in codes.values():
+        width = lane_engine.pick_width(lanes, 1, code)
+        if width not in (64, lanes):
+            warm_pairs.add((width, _code_bucket(len(code))))
+    for width, bucket in sorted(warm_pairs):
+        for seed_bucket in (16, width):
+            lane_engine.warm_variant(
+                width, bucket, {}, lane_engine.DEFAULT_WINDOW,
+                lane_engine.DEFAULT_STEP_BUDGET,
+                seed_bucket=seed_bucket, block=True)
+    # ...and an UNTIMED throwaway lane sweep: the device-screen
+    # kernels (models/pruner._device_prefilter -> ops/propagate /
+    # ops/intervals) cold-trace+compile per constraint-DAG bucket the
+    # first time a contract's wave engages them (~20-40 s; tracing is
+    # NOT covered by the persistent compile cache), and window-variant
+    # warm-up cannot reach them. The full stage set used to absorb
+    # this in bench_prefilter; the reduced set (BENCH_r06) landed it
+    # in ether_send.sol.o's timed region instead. One throwaway pass
+    # compiles every shape the timed sweep will see — the declared
+    # measurement is steady state. BENCH_WARM_LANE=0 skips.
+    if os.environ.get("BENCH_WARM_LANE", "1") != "0":
+        for path in fixtures:
+            try:
+                bench_corpus.analyze_one(path, timeout, lanes)
+            except Exception:
+                pass
     walls, issues, single_chip, lane_errors = _sweep(lanes)
     if os.environ.get("BENCH_DUMP_WARM"):
         print(json.dumps({"warm_variants":
@@ -1273,6 +1317,159 @@ def _smoke_merge():
     return result
 
 
+def build_static_dead_contract(k=5, tail=160):
+    """k symbolic forks, one SELFDESTRUCT branch (the reachable issue),
+    a final concrete SSTORE, then a long pure-arithmetic tail to STOP:
+    for a {AccidentallyKillable, ArbitraryStorage} run every lane past
+    the SSTORE can reach no active detector site — the static-retire
+    shape (docs/static_pass.md)."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray()
+    for i in range(k):
+        c += push(i) + bytes([op["CALLDATALOAD"]])
+        c += push(1) + bytes([op["AND"]])
+        j = len(c)
+        c += push(0, 2) + bytes([op["JUMPI"]])
+        c += bytes([op["JUMPDEST"]])
+        jf = len(c)
+        c += push(0, 2) + bytes([op["JUMP"]])
+        t = len(c)
+        c[j + 1:j + 3] = t.to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"]])
+        jt = len(c)
+        c += push(0, 2) + bytes([op["JUMP"]])
+        r = len(c)
+        c[jf + 1:jf + 3] = r.to_bytes(2, "big")
+        c[jt + 1:jt + 3] = r.to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"]])
+    c += push(31) + bytes([op["CALLDATALOAD"]])
+    c += push(0xDEAD, 2) + bytes([op["EQ"]])
+    j = len(c)
+    c += push(0, 2) + bytes([op["JUMPI"]])
+    c += push(1) + push(0) + bytes([op["SSTORE"]])
+    c += push(5)
+    for _ in range(tail):
+        c += push(3) + bytes([op["MUL"]]) + push(7) + bytes([op["ADD"]])
+    c += bytes([op["POP"], op["STOP"]])
+    d = len(c)
+    c[j + 1:j + 3] = d.to_bytes(2, "big")
+    c += bytes([op["JUMPDEST"], op["CALLER"], op["SELFDESTRUCT"]])
+    return bytes(c)
+
+
+def _smoke_static():
+    """Stage 8: the static pre-analysis gate (docs/static_pass.md).
+
+    The rigged detector-dead-tail contract (build_static_dead_contract)
+    runs through the REAL window drain at 64 lanes / 32-step windows
+    with the detector set restricted to {AccidentallyKillable,
+    ArbitraryStorage} and one transaction (final-round retire rules
+    apply). Gates:
+
+    * ``static_retired_lanes > 0`` — lanes provably died at a window
+      boundary with zero solver work;
+    * ``static_jumps_resolved > 0`` — the jump table resolved sites;
+    * issue-set identity between MTPU_STATIC on and off, on both the
+      lane path and the host path (no issue ever came from a retired
+      lane's subtree).
+
+    Wall-clock is NOT gated (single-CPU container constraint): the
+    evidence is avoided-work counters and issue identity."""
+    from mythril_tpu.analysis import static_pass
+    from mythril_tpu.analysis.static_pass import memo as static_memo
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.analysis_args import make_cmd_args
+
+    code = build_static_dead_contract(k=5, tail=160)
+    modules = ["AccidentallyKillable", "ArbitraryStorage"]
+    ss = SolverStatistics()
+
+    def analyze(static_on, tpu_lanes):
+        static_pass.FORCE = static_on
+        try:
+            reset_analysis_state()
+            static_memo.clear()
+            c0 = dict(ss.batch_counters())
+            dis = MythrilDisassembler(eth=None)
+            address, _ = dis.load_from_bytecode(code.hex(),
+                                                bin_runtime=True)
+            analyzer = MythrilAnalyzer(
+                disassembler=dis,
+                cmd_args=make_cmd_args(execution_timeout=120,
+                                       tpu_lanes=tpu_lanes),
+                strategy="bfs", address=address)
+            report = analyzer.fire_lasers(modules=list(modules),
+                                          transaction_count=1)
+            c1 = ss.batch_counters()
+            return {
+                "issues": sorted((i.swc_id, i.address, i.title)
+                                 for i in report.issues.values()),
+                "counters": {k: round(c1[k] - c0.get(k, 0), 1)
+                             for k in ("static_blocks",
+                                       "static_jumps_resolved",
+                                       "static_retired_lanes",
+                                       "static_pruner_skips")},
+            }
+        finally:
+            static_pass.FORCE = None
+
+    lane_engine.PATH_HISTORY[code] = 64
+    lane_engine.FORCE_WIDTH = 64
+    old_window = lane_engine.DEFAULT_WINDOW
+    lane_engine.DEFAULT_WINDOW = 32
+    try:
+        lane_engine.warm_variant(
+            64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        lane_off = analyze(False, 64)
+        lane_on = analyze(True, 64)
+    finally:
+        lane_engine.FORCE_WIDTH = None
+        lane_engine.DEFAULT_WINDOW = old_window
+    host_off = analyze(False, 0)
+    host_on = analyze(True, 0)
+
+    lc = lane_on["counters"]
+    result = {
+        "lane": {
+            "static_retired_lanes": lc["static_retired_lanes"],
+            "static_jumps_resolved": lc["static_jumps_resolved"],
+            "static_blocks": lc["static_blocks"],
+            "issues_identical": lane_on["issues"] == lane_off["issues"],
+        },
+        "host": {
+            "issues_identical": host_on["issues"] == host_off["issues"],
+        },
+        "off_really_off": (
+            lane_off["counters"]["static_retired_lanes"] == 0
+            and lane_off["counters"]["static_blocks"] == 0),
+        "issues": lane_on["issues"],
+    }
+    result["ok"] = bool(
+        lc["static_retired_lanes"] > 0
+        and lc["static_jumps_resolved"] > 0
+        and result["lane"]["issues_identical"]
+        and result["host"]["issues_identical"]
+        and result["off_really_off"]
+        and len(lane_on["issues"]) > 0
+        and lane_on["issues"] == host_on["issues"]
+    )
+    return result
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
@@ -1319,7 +1516,14 @@ def bench_smoke():
        nonzero lanes_merged AND lanes_subsumed, post-merge live-lane
        count strictly below the MTPU_MERGE=0 run, open-state screen
        queries saved at the svm round boundary, and issue-set identity
-       with merge on vs off at both seams. Any miss exits 1.
+       with merge on vs off at both seams. Any miss exits 1;
+    8. the static pre-analysis gate (_smoke_static,
+       docs/static_pass.md): a rigged fixture with a large
+       detector-dead region (pure-arithmetic tail after the last
+       SSTORE) gates static_retired_lanes > 0,
+       static_jumps_resolved > 0, and issue-set identity with
+       MTPU_STATIC on vs off on both the lane and host paths. Any
+       miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -1480,6 +1684,19 @@ def bench_smoke():
     else:
         out["merge"] = {"skipped": True, "ok": True}
 
+    # stage 8: the static pre-analysis gate (rigged detector-dead-tail
+    # fixture through the real window drain: statically-retired lanes,
+    # resolved jump sites, issue identity vs MTPU_STATIC=0 on both
+    # paths; skippable for the quick inner loop via MTPU_SMOKE_STATIC=0)
+    if os.environ.get("MTPU_SMOKE_STATIC", "1") != "0":
+        try:
+            out["static"] = _smoke_static()
+        except Exception as e:
+            out["static"] = {"ok": False, "error": type(e).__name__,
+                             "detail": str(e)[:200]}
+    else:
+        out["static"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -1507,7 +1724,10 @@ def bench_smoke():
           # storm, post-merge live-lane count strictly below the
           # unmerged run, open-state screen queries saved, and issue
           # identity vs MTPU_MERGE=0 at both seams
-          and out["merge"].get("ok", False))
+          and out["merge"].get("ok", False)
+          # the static gate: retired lanes and resolved jumps on the
+          # detector-dead-tail fixture, issue identity vs MTPU_STATIC=0
+          and out["static"].get("ok", False))
     return 0 if ok else 1
 
 

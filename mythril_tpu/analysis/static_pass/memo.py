@@ -1,0 +1,69 @@
+"""Per-code-hash memoization and migration shipping of static results.
+
+The analysis is pure in the code bytes, so results key on a content
+hash and are shared process-wide; corpus re-analyses and re-seeded
+engines never re-derive. Entries are plain picklable data (namedtuples
+of ints/frozensets + one numpy array — no SMT terms), so migration
+batches ship them whole (support/checkpoint.save_static_sidecar) and
+a thief imports them ahead of its resume instead of re-analyzing.
+"""
+
+import hashlib
+import logging
+import threading
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_MEMO: Dict[str, object] = {}
+_MEMO_CAP = 256  # a corpus run touches a few dozen codes
+
+
+def code_hash(code: bytes) -> str:
+    return hashlib.sha256(code).hexdigest()
+
+
+def get(key: str):
+    with _LOCK:
+        return _MEMO.get(key)
+
+
+def put(key: str, info) -> None:
+    with _LOCK:
+        if len(_MEMO) >= _MEMO_CAP:
+            _MEMO.pop(next(iter(_MEMO)))
+        _MEMO[key] = info
+
+
+def clear() -> None:
+    with _LOCK:
+        _MEMO.clear()
+
+
+def export_entries(keys: Optional[List[str]] = None) -> List:
+    """StaticInfo entries to ship with a migration batch (all memoized
+    codes by default — a run's memo is a handful of contracts)."""
+    with _LOCK:
+        if keys is None:
+            return list(_MEMO.values())
+        return [_MEMO[k] for k in keys if k in _MEMO]
+
+
+def import_entries(entries: List) -> int:
+    """Adopt shipped entries (idempotent; existing keys win — they are
+    derived from identical bytes)."""
+    n = 0
+    for info in entries:
+        key = getattr(info, "code_hash", None)
+        if not key:
+            continue
+        with _LOCK:
+            if key not in _MEMO:
+                if len(_MEMO) >= _MEMO_CAP:
+                    _MEMO.pop(next(iter(_MEMO)))
+                _MEMO[key] = info
+                n += 1
+    if n:
+        log.info("imported %d shipped static-pass entries", n)
+    return n

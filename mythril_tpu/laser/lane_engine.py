@@ -1120,11 +1120,20 @@ _STATE_POOL: Dict[tuple, List[SymLaneState]] = {}
 
 
 def _compiled_code(code_bytes: bytes, fentries) -> "CompiledCode":
-    key = (code_bytes, tuple(sorted(fentries)))
+    from ..analysis import static_pass
+
+    static_on = static_pass.enabled()
+    key = (code_bytes, tuple(sorted(fentries)), static_on)
     cc = _CC_CACHE.get(key)
     if cc is None:
+        det_mask = None
+        if static_on:
+            info = static_pass.info_for(code_bytes)
+            if info is not None:
+                det_mask = info.reach_mask
         with _prof("compile_code"):
-            cc = compile_code(code_bytes, func_entries=key[1])
+            cc = compile_code(code_bytes, func_entries=key[1],
+                              det_mask=det_mask)
         if len(_CC_CACHE) >= 64:  # bound device-resident code tensors
             _CC_CACHE.pop(next(iter(_CC_CACHE)))
         _CC_CACHE[key] = cc
@@ -1520,6 +1529,10 @@ class LaneEngine:
         self._record_memo: Dict[tuple, int] = {}
         self._fired_sites: set = set()
         self._memo_pins: list = []
+        #: static pre-analysis of the current explore's code (None =
+        #: gate off / unavailable) + per-template pending-PI memo
+        self._static_info = None
+        self._static_clean: Dict[int, bool] = {}
         self.stats = {
             "seeded": 0, "reseeded": 0, "forks": 0, "records": 0,
             "parked": 0, "dead": 0, "device_steps": 0, "windows": 0,
@@ -1533,7 +1546,19 @@ class LaneEngine:
             "fork_screened": 0, "fork_killed": 0,
             # window-boundary merge/subsume pass (docs/lane_merge.md)
             "lanes_merged": 0, "lanes_subsumed": 0, "merge_rounds": 0,
+            # static pre-analysis consumers (docs/static_pass.md)
+            "static_retired": 0, "static_jump_patches": 0,
         }
+        # static-pass run context, set by svm per sweep (the engine is
+        # cached across sweeps and transactions): the active-detector
+        # anchor mask (None = screen off), whether the current round is
+        # the run's last (open states unused afterwards), and whether
+        # patching a statically-resolved symbolic JUMP dest is safe
+        # (off while an arbitrary-jump-class detector is active — its
+        # issue PREDICATE is the dest's symbolicness)
+        self.static_active_mask = None
+        self.static_final_tx = False
+        self.static_jump_patch_ok = False
         # in-place SHA3 resume: off whenever a detector hooks SHA3
         # (the hook must fire host-side; no adapter lifts SHA3 today)
         self.resume_on = "SHA3" not in set(blocked_ops or ())
@@ -2416,6 +2441,7 @@ class LaneEngine:
         self._record_memo.clear()
         self._fired_sites.clear()
         self._memo_pins.clear()
+        self._static_clean.clear()
 
     # -- overlapped fork-feasibility screening -------------------------------
 
@@ -2528,6 +2554,117 @@ class LaneEngine:
                 if v == solver_batch.UNSAT]
 
     # -- window-boundary lane merge / subsumption ----------------------------
+
+    def _template_static_clean(self, ctx: LaneCtx) -> bool:
+        """No pending PotentialIssues ride the lane's seed state (a
+        statically-dead lane carrying one must still reach a terminator
+        to discharge it). Memoized per template per explore."""
+        key = id(ctx.template)
+        cached = self._static_clean.get(key)
+        if cached is None:
+            try:
+                from ..analysis.potential_issues import (
+                    PotentialIssuesAnnotation,
+                )
+
+                cached = not any(
+                    isinstance(a, PotentialIssuesAnnotation)
+                    and a.potential_issues
+                    for a in ctx.template.annotations)
+            except Exception:
+                cached = False
+            self._static_clean[key] = cached
+            self._memo_pins.append(ctx.template)
+        return cached
+
+    def _static_retire(self, status, ctxs, dead_set, kill,
+                       counts_h, resumes) -> None:
+        """Window-boundary static retire (docs/static_pass.md): a lane
+        whose per-PC reachable-detector mask has no bit in common with
+        the run's active-detector mask can never mint another issue; if
+        additionally no open-state terminator is reachable — or no
+        later round consumes open states and nothing is pending on the
+        lane — it retires on the next dispatch's kill list with ZERO
+        solver or materialization work (`statically_retired`). Runs
+        BEFORE the merge pass so retired lanes never cost a fingerprint
+        dispatch. Gated by MTPU_STATIC via the info lookup and by svm
+        actually setting an active mask."""
+        info = self._static_info
+        active = self.static_active_mask
+        if info is None or active is None:
+            return
+        from ..analysis.static_pass import TERMINATOR_BIT
+
+        active = int(active)
+        final_tx = bool(self.static_final_tx)
+        excluded = dead_set | set(kill) | {r[0] for r in resumes}
+        pcs = counts_h["pc"]
+        retired = 0
+        for lane in range(self.n_lanes):
+            ctx = ctxs[lane]
+            if (ctx is None or lane in excluded
+                    or status[lane] != Status.RUNNING):
+                continue
+            if ctx.promos:
+                continue  # pending drain promotions: must materialize
+            mask = info.mask_at(int(pcs[lane]))
+            if mask & active:
+                continue
+            if mask & int(TERMINATOR_BIT):
+                if not final_tx or not self._template_static_clean(ctx):
+                    continue
+            kill.append(lane)
+            retired += 1
+        if retired:
+            self.stats["static_retired"] += retired
+            from ..smt.solver.solver_statistics import SolverStatistics
+
+            SolverStatistics().bump(static_retired_lanes=retired)
+            log.info("static pass retired %d lanes at the window "
+                     "boundary", retired)
+
+    def _patch_jump_parks(self, results: List[GlobalState]
+                          ) -> List[GlobalState]:
+        """Consult the static jump table before a symbolic-dest JUMP
+        park falls back to the host interpreter (which ends the path —
+        instructions.jump_ raises on a symbolic dest). A site whose
+        value-set resolved to EXACTLY one target continues there, with
+        the dest == target equality appended as a path condition
+        (implied true by the resolution's soundness, so the issue set
+        cannot grow; and were the resolution ever wrong, the constraint
+        makes the wrong continuation infeasible rather than unsound).
+        Disabled while an arbitrary-jump-class detector is active."""
+        info = self._static_info
+        if info is None or not self.static_jump_patch_ok \
+                or not info.jump_table:
+            return results
+        patched = 0
+        for gs in results:
+            try:
+                ilist = gs.environment.code.instruction_list
+                pc = gs.mstate.pc
+                if pc >= len(ilist) or ilist[pc]["opcode"] != "JUMP":
+                    continue
+                stack = gs.mstate.stack
+                if not stack:
+                    continue
+                dest = stack[-1]
+                if getattr(dest, "symbolic", False) is not True:
+                    continue
+                targets = info.jump_table.get(ilist[pc]["address"])
+                if not targets or len(targets) != 1:
+                    continue
+                target = symbol_factory.BitVecVal(targets[0], 256)
+                gs.world_state.constraints.append(dest == target)
+                stack[-1] = target
+                patched += 1
+            except Exception:
+                continue
+        if patched:
+            self.stats["static_jump_patches"] += patched
+            log.info("static jump table resolved %d symbolic JUMP "
+                     "parks in place", patched)
+        return results
 
     def _window_merge(self, st, status, ctxs, dead_set, kill,
                       counts_h, resumes) -> None:
@@ -2650,6 +2787,17 @@ class LaneEngine:
         ) if entry_states else {}
         stats0 = dict(self.stats)  # engines persist across explores
         self._reset_explore_memos()
+        # static pre-analysis (docs/static_pass.md): memoized per code
+        # hash; feeds the window-boundary retire, the jump-table
+        # consult on symbolic JUMP parks, and the det-mask plane the
+        # compile below ships with the code tensors
+        try:
+            from ..analysis import static_pass
+
+            self._static_info = static_pass.info_for(code_bytes)
+        except Exception as e:  # a screen, never an error path
+            log.debug("static pass unavailable: %s", e)
+            self._static_info = None
         cc = _compiled_code(code_bytes, self._func_names.keys())
         if self._rep_sh is not None:
             # SPMD mode: code tensors (and the op tables) replicate
@@ -3096,6 +3244,14 @@ class LaneEngine:
                         kill.append(lane)
                         self.stats["fork_killed"] += 1
                 screen_dead = []
+                # window-boundary STATIC retire (MTPU_STATIC,
+                # docs/static_pass.md): lanes whose remaining
+                # reachable-detector mask is dead against the active
+                # mask ride the next dispatch's kill list with zero
+                # solver/materialize work. Runs BEFORE the merge pass,
+                # which then never pays fingerprint work for them.
+                self._static_retire(status, ctxs, dead_set, kill,
+                                    counts_h, resumes)
                 # window-boundary lane merge/subsume (MTPU_MERGE,
                 # docs/lane_merge.md): exact-frontier twins collapse
                 # under an OR'd constraint suffix, implied siblings
@@ -3153,6 +3309,10 @@ class LaneEngine:
             except Exception:
                 self._visited_dev.pop(code_bytes, None)
         self._release_state(st)
+        # static jump-table consult (docs/static_pass.md): a symbolic-
+        # dest JUMP park with a statically-proved singleton target
+        # continues in place instead of dying in the interpreter
+        results = self._patch_jump_parks(results)
         global LAST_RUN_STATS
         delta = {k: v - stats0.get(k, 0) for k, v in self.stats.items()}
         if peak_demand > PATH_HISTORY.get(code_bytes, 0):

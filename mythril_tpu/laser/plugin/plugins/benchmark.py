@@ -146,6 +146,21 @@ class BenchmarkPlugin(LaserPlugin):
                     counters["worker_deaths"],
                     counters["async_overlap_ms"],
                 )
+            # static bytecode pre-analysis (docs/static_pass.md):
+            # blocks recovered, jump sites resolved, lanes/states
+            # retired with zero solver work, pruner probes answered
+            # by set-disjointness
+            if counters["static_blocks"] or \
+                    counters["static_retired_lanes"] or \
+                    counters["static_pruner_skips"]:
+                log.info(
+                    "Static pass: blocks=%d jumps_resolved=%d "
+                    "retired=%d pruner_skips=%d",
+                    counters["static_blocks"],
+                    counters["static_jumps_resolved"],
+                    counters["static_retired_lanes"],
+                    counters["static_pruner_skips"],
+                )
             # migration-bus verdict shipping (docs/work_stealing.md):
             # proofs exported with stolen batches / replayed from a
             # victim's sidecar before a resume

@@ -150,8 +150,64 @@ class DependencyPruner(LaserPlugin):
 
     # -- the skip decision -------------------------------------------------
 
+    @staticmethod
+    def _concrete_values(terms):
+        """Concrete ints of a term collection, or None when any term
+        is symbolic (the static fast path then stands down)."""
+        out = set()
+        for t in terms:
+            v = getattr(t, "value", None)
+            if v is None:
+                return None
+            out.add(v)
+        return out
+
+    def _static_no_rerun(self, address: int,
+                         annotation: DependencyAnnotation,
+                         static_info) -> bool:
+        """Static wake-up fast path (analysis/static_pass block
+        summaries): when every previous-tx write slot and every slot
+        loaded so far this tx is CONCRETE, the block's complete
+        concrete reachable-read set is known, no CALL is reachable,
+        and the write values are disjoint from both the reachable
+        reads and the loaded slots, the pairwise may-alias walk (|W| x
+        |R| probes) is provably all-False — the block skips without
+        it. Reachable reads over-approximate every slot value any
+        execution through this block can load (the value-set analysis'
+        soundness contract), so a concrete write outside the set can
+        never alias a recorded read."""
+        if static_info is None:
+            return False
+        rr = static_info.reach_reads.get(address)
+        if rr is None or static_info.reach_calls.get(address, True):
+            return False
+        writes = self._concrete_values(
+            annotation.get_storage_write_cache(self.iteration - 1))
+        if writes is None or not writes:
+            return False
+        # check (3)'s conservatism, statically: the block-address-
+        # as-read-slot rule can only fire when `address` is a read
+        # slot SOMEWHERE — the complete whole-code read union rules
+        # that out without touching term hashes
+        all_reads = static_info.all_read_slots
+        if all_reads is None or address in all_reads:
+            return False
+        loaded = self._concrete_values(annotation.storage_loaded)
+        if loaded is None:
+            return False
+        if writes & rr or writes & loaded:
+            return False
+        try:
+            from ....smt.solver.solver_statistics import SolverStatistics
+
+            SolverStatistics().bump(static_pruner_skips=1)
+        except Exception:
+            pass
+        return True
+
     def _must_rerun(self, address: int,
-                    annotation: DependencyAnnotation) -> bool:
+                    annotation: DependencyAnnotation,
+                    static_info=None) -> bool:
         """Does re-executing the (previously seen) block at `address`
         possibly observe the previous transaction's writes?"""
         deps = self._deps.get(address)
@@ -159,6 +215,8 @@ class DependencyPruner(LaserPlugin):
             return True
         if deps is None or not deps.reads:
             return False  # no read on any path through it: pure
+        if self._static_no_rerun(address, annotation, static_info):
+            return False
         prev_writes = annotation.get_storage_write_cache(
             self.iteration - 1)
         # reference conservatism (storage_accessed_global): a block
@@ -191,7 +249,15 @@ class DependencyPruner(LaserPlugin):
             if address not in annotation.blocks_seen:
                 annotation.blocks_seen.add(address)
                 return
-            if self._must_rerun(address, annotation):
+            static_info = None
+            try:
+                from ....analysis import static_pass
+
+                static_info = static_pass.info_for_code_obj(
+                    state.environment.code)
+            except Exception:
+                pass
+            if self._must_rerun(address, annotation, static_info):
                 return
             log.debug(
                 "Skipping state: previous-tx writes %s cannot reach a "

@@ -130,6 +130,18 @@ class InstructionProfiler(LaserPlugin):
                         counters["worker_deaths"],
                         counters["async_overlap_ms"],
                     ))
+            # static bytecode pre-analysis (docs/static_pass.md)
+            if counters["static_blocks"] or \
+                    counters["static_retired_lanes"] or \
+                    counters["static_pruner_skips"]:
+                lines.append(
+                    "Static pass: blocks={} jumps_resolved={} "
+                    "retired={} pruner_skips={}".format(
+                        counters["static_blocks"],
+                        counters["static_jumps_resolved"],
+                        counters["static_retired_lanes"],
+                        counters["static_pruner_skips"],
+                    ))
             # migration-bus verdict shipping (docs/work_stealing.md)
             if counters["verdicts_shipped"] or \
                     counters["verdicts_replayed"]:

@@ -92,6 +92,18 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
             if cur_instr["opcode"].upper() != "JUMPDEST":
                 return state
 
+            # static loop-head feed (analysis/static_pass, MTPU_STATIC):
+            # a JUMPDEST outside every non-trivial SCC of this code's
+            # conservative CFG cannot sit on a repeating cycle of this
+            # code, so the O(trace) backward scan below is skipped
+            # there. Cross-code cycles (A calls B in a loop) still
+            # prune — at A's own cycle JUMPDEST, at most a fraction of
+            # one iteration later (PARITY.md).
+            cycle_pcs = self._static_cycle_pcs(state)
+            if cycle_pcs is not None \
+                    and cur_instr["address"] not in cycle_pcs:
+                return state
+
             count = _cycle_count(annotation.trace)
 
             # creation code gets a much higher bound: constructors often
@@ -104,6 +116,17 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
                 log.debug("Loop bound reached, skipping state")
                 continue
             return state
+
+    @staticmethod
+    def _static_cycle_pcs(state: GlobalState):
+        """Cycle-candidate JUMPDESTs of the state's code, or None when
+        the static pass is off/unavailable (scan everywhere)."""
+        try:
+            from ....analysis import static_pass
+
+            return static_pass.cycle_pcs_for(state.environment.code)
+        except Exception:
+            return None
 
     def run_check(self):
         return self.super_strategy.run_check()

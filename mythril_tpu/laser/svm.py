@@ -95,6 +95,12 @@ class LaserEVM:
         # round, and the per-round snapshot callback
         self.start_round: int = 0
         self.checkpoint_sink: Optional[Callable] = None
+        # static pre-analysis round context (docs/static_pass.md):
+        # True while the CURRENT message-call round is the run's last —
+        # its open states seed nothing, so a statically-dead state may
+        # retire even when a terminator is reachable (if nothing is
+        # pending on it). Defaults conservative.
+        self._static_final_tx: bool = False
 
         self.pre_hooks: Dict[str, List[Callable]] = defaultdict(list)
         self.post_hooks: Dict[str, List[Callable]] = defaultdict(list)
@@ -268,6 +274,9 @@ class LaserEVM:
                         func_hashes[itr] = bytes.fromhex(
                             hex(func_hash)[2:].zfill(8)
                         )
+            # static-retire round context: open states of the LAST
+            # round seed nothing (docs/static_pass.md)
+            self._static_final_tx = i + 1 >= self.transaction_count
             # round context for the migration bus's MID-ROUND yield
             # (parallel/migrate.py): states finishing round i await
             # round i+1, so a slice exported while round i still runs
@@ -570,6 +579,44 @@ class LaserEVM:
             and not _essential(self.post_hooks.get("STOP", []))
         )
 
+        # static pre-analysis run context (docs/static_pass.md): the
+        # active-detector mask derives from the registered detector
+        # hooks' owning modules — exactly the set whose issues this run
+        # can mint. The issue-annotation mode diverts issues onto
+        # states, so the retire screen stays off there (a retired
+        # state could carry an undelivered issue).
+        static_mask = None
+        static_patch_ok = False
+        try:
+            from ..analysis import static_pass
+
+            if static_pass.enabled() and can_lift:
+                from ..analysis.module.base import DetectionModule
+
+                active_mods = {
+                    h.__self__
+                    for hook_dict in (self.pre_hooks, self.post_hooks)
+                    for hooks in hook_dict.values()
+                    for h in hooks
+                    if isinstance(getattr(h, "__self__", None),
+                                  DetectionModule)
+                }
+                # a run with NO detection modules registered is not an
+                # analysis run — its product is the explored state
+                # space itself (open states, coverage, statespace), so
+                # the retire screen must stand down entirely rather
+                # than treat "no detectors" as "everything is dead"
+                if active_mods:
+                    static_mask = int(
+                        static_pass.active_mask_for_modules(
+                            active_mods))
+                    static_patch_ok = all(
+                        type(m).__name__ != "ArbitraryJump"
+                        for m in active_mods)
+        except Exception as e:
+            log.debug("static pass context unavailable: %s", e)
+        static_final = bool(self._static_final_tx)
+
         for code, states in groups.items():
             # width right-sizing: args.tpu_lanes is the CAP; the engine
             # runs at the smallest bucket that fits this batch with
@@ -627,12 +674,26 @@ class LaserEVM:
                     if len(same) > 2:
                         # evict the narrowest (width, mesh) variant
                         del cache[min(same, key=lambda k: (k[1], k[2]))]
+                engine.static_active_mask = static_mask
+                engine.static_final_tx = static_final
+                engine.static_jump_patch_ok = static_patch_ok
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(
                     "lane engine failed (%s); continuing host-side", e)
                 self.work_list.extend(states)
                 continue
+            if static_mask is not None:
+                # host-side twin of the window-boundary retire: parked
+                # states that are statically dead never re-enter the
+                # worklist (same soundness test, docs/static_pass.md)
+                try:
+                    from ..analysis import static_pass
+
+                    parked = static_pass.screen_states(
+                        parked, static_mask, static_final)
+                except Exception as e:
+                    log.debug("static state screen failed: %s", e)
             run = engine.last_run_stats
             if slim_stop:
                 # transaction-end shortcut: lane-retired states parked

@@ -191,13 +191,13 @@ class CompiledCode(NamedTuple):
     contract — the analog of the reference's Disassembly object for the
     device path).
 
-    Stored as ONE packed (L+1, 12) i32 device array: separate per-field
+    Stored as ONE packed (L+1, 13) i32 device array: separate per-field
     H2D transfers each pay full link latency on a tunneled backend, and
     a jitted unpack dispatch pays an XLA compile per code bucket. The
     field views below slice the packed array — inside a trace XLA fuses
     them away; outside they are cheap lazy device ops."""
 
-    packed: jnp.ndarray  # (L+1, 12) int32, see column layout below
+    packed: jnp.ndarray  # (L+1, 13) int32, see column layout below
     size: int  # real code length (static)
 
     @property
@@ -223,6 +223,15 @@ class CompiledCode(NamedTuple):
         return lax.bitcast_convert_type(
             self.packed[:, 4:4 + bv256.NLIMBS], jnp.uint32)
 
+    @property
+    def det_mask(self):  # (L+1,) u32 — reachable-detector-class mask
+        # (analysis/static_pass reach.OP_BITS bits; all-zero when the
+        # static pass is off — consumers treat 0 rows at pc 0 as "no
+        # static info", see lane_engine._static_retire)
+        from jax import lax
+
+        return lax.bitcast_convert_type(self.packed[:, 12], jnp.uint32)
+
 
 # padded code-tensor sizes: every distinct tensor length is a separate
 # XLA compilation of the (large) stepper kernels, so contracts share a
@@ -243,10 +252,14 @@ def _code_bucket(length: int) -> int:
     return length
 
 
-def compile_code(code: bytes, func_entries=()) -> CompiledCode:
+def compile_code(code: bytes, func_entries=(),
+                 det_mask=None) -> CompiledCode:
     """func_entries: byte addresses of function entry points (the
     Disassembly's address_to_function_name keys); lanes jumping there
-    record it so materialized states carry the active function name."""
+    record it so materialized states carry the active function name.
+    det_mask: optional (len(code)+1,) uint32 per-PC reachable-detector
+    mask from the static pass (analysis/static_pass) — ships as one
+    more PC-indexed plane; zeros (= "no static info") when absent."""
     length = len(code)
     padded = _code_bucket(length)
     opcode = np.full(padded + 1, _OP["STOP"], dtype=np.int32)
@@ -271,11 +284,16 @@ def compile_code(code: bytes, func_entries=()) -> CompiledCode:
             is_jumpdest[i] = True
         i = next_pc[i]
 
+    mask_col = np.zeros(padded + 1, dtype=np.uint32)
+    if det_mask is not None:
+        n = min(len(det_mask), padded + 1)
+        mask_col[:n] = np.asarray(det_mask[:n], dtype=np.uint32)
     packed = np.concatenate([
         opcode[:, None], next_pc[:, None],
         is_jumpdest[:, None].astype(np.int32),
         is_func_entry[:, None].astype(np.int32),
         push_value.view(np.int32),
+        mask_col[:, None].view(np.int32),
     ], axis=1)
     return CompiledCode(packed=jnp.asarray(packed), size=length)
 

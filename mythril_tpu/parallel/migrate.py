@@ -274,6 +274,20 @@ class MigrationBus:
                 entries = self._entries_for(chunk, ship)
                 if entries and save_verdict_sidecar(side, entries):
                     paths.append(side)
+            # static-pass results ship like verdict sidecars
+            # (docs/static_pass.md): pure per-code-hash data, so the
+            # thief seeds its memo instead of re-deriving CFG/masks
+            try:
+                from ..analysis.static_pass import memo as static_memo
+                from ..support.checkpoint import save_static_sidecar
+
+                sentries = static_memo.export_entries()
+                if sentries:
+                    sside = self.dir / f"offer_{offer_id}.static"
+                    if save_static_sidecar(sside, sentries):
+                        paths.append(sside)
+            except Exception as e:
+                log.debug("static sidecar export failed: %s", e)
             meta = {
                 "contract": self.current_contract,
                 "code_id": code_id,
@@ -559,6 +573,20 @@ def analyze_batch(meta: dict, batch_path, timeout: int,
                          n, Path(batch_path).name)
         except Exception as e:
             log.debug("verdict replay failed (%s); re-proving", e)
+        # the static sidecar rides beside the verdict one (same
+        # offer id, .static suffix); adopt it before the resume so
+        # the engines see warm static-pass memo entries
+        try:
+            from ..analysis.static_pass import memo as static_memo
+            from ..support.checkpoint import load_static_sidecar
+
+            static_path = Path(str(verdicts_path)).with_suffix(
+                ".static")
+            sentries = load_static_sidecar(static_path)
+            if sentries:
+                static_memo.import_entries(sentries)
+        except Exception as e:
+            log.debug("static sidecar import failed: %s", e)
 
     batch_path = Path(batch_path)
     work = batch_path.with_name(

@@ -217,6 +217,40 @@ def load_verdict_sidecar(path) -> list:
         return []
 
 
+def save_static_sidecar(path, entries) -> bool:
+    """Write a migration batch's static-pass sidecar: memoized
+    analysis/static_pass.StaticInfo entries (plain picklable data — no
+    terms, so no flat-table framing needed). Best-effort, like the
+    verdict sidecar: a failure must never block the batch."""
+    try:
+        path = str(path)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)) or ".",
+            prefix=".ssc-")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(list(entries), f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+        return True
+    except Exception as e:
+        log.warning("static sidecar save failed (%s); batch ships "
+                    "without static results", e)
+        return False
+
+
+def load_static_sidecar(path) -> list:
+    """Inverse of save_static_sidecar; absent/corrupt loads as empty
+    (the thief re-analyzes — milliseconds, never wrong)."""
+    try:
+        if not os.path.exists(str(path)):
+            return []
+        with open(str(path), "rb") as f:
+            return list(pickle.load(f))
+    except Exception as e:
+        log.warning("static sidecar load failed (%s); re-analyzing", e)
+        return []
+
+
 def save_checkpoint(path: str, round_index: int, open_states,
                     target_address: int, code_id: str,
                     include_modules: bool = True) -> None:

@@ -1,0 +1,87 @@
+"""Disassembler / block-recovery edge cases locked as regressions for
+the static pass (ISSUE 7 satellite): JUMPDEST bytes inside PUSH
+immediates are data, truncated trailing PUSHes decode, empty code
+bodies analyze."""
+
+from mythril_tpu.analysis import static_pass
+from mythril_tpu.analysis.static_pass import blocks as blocks_mod
+from mythril_tpu.disassembler import asm
+
+JUMPDEST = 0x5B
+
+
+class TestJumpdestInsidePushData:
+    # PUSH2 0x5b00 | PUSH1 0x01 | JUMP — byte offset 1 is 0x5b but it
+    # is immediate data; offset 1 must be neither an instruction start
+    # nor a jump target
+    CODE = bytes([0x61, 0x5B, 0x00, 0x60, 0x01, 0x56])
+
+    def test_linear_sweep_consumes_immediate(self):
+        ops = [(i["address"], i["opcode"])
+               for i in asm.disassemble(self.CODE)]
+        assert ops == [(0, "PUSH2"), (3, "PUSH1"), (5, "JUMP")]
+
+    def test_not_a_valid_jumpdest(self):
+        assert blocks_mod.valid_jumpdests(self.CODE) == frozenset()
+
+    def test_not_a_block_start(self):
+        info = static_pass.analyze(self.CODE)
+        assert 1 not in info.block_starts
+        # the JUMP resolves to offset 1, which is NOT a legal dest:
+        # the resolved target set is complete and empty
+        assert info.jump_table == {5: ()}
+
+    def test_device_jumpdest_table_agrees(self):
+        from mythril_tpu.ops.stepper import compile_code
+
+        cc = compile_code(self.CODE)
+        import numpy as np
+
+        jd = np.asarray(cc.is_jumpdest)
+        assert not jd[1], "0x5b inside PUSH data marked jumpable"
+
+    def test_real_jumpdest_after_push_data(self):
+        # same code + a real JUMPDEST appended
+        code = self.CODE + bytes([JUMPDEST])
+        assert blocks_mod.valid_jumpdests(code) == frozenset({6})
+        info = static_pass.analyze(code)
+        assert 6 in info.block_starts
+
+
+class TestTruncatedTrailingPush:
+    # PUSH3 with only one immediate byte present
+    CODE = bytes([0x60, 0x01, 0x62, 0xAA])
+
+    def test_linear_sweep(self):
+        ops = asm.disassemble(self.CODE)
+        assert [i["opcode"] for i in ops] == ["PUSH1", "PUSH3"]
+        assert ops[1]["argument"] == "0xaa"
+
+    def test_static_pass_decodes(self):
+        instrs = blocks_mod.decode(self.CODE)
+        assert [(i.pc, i.op) for i in instrs] == [(0, "PUSH1"),
+                                                 (2, "PUSH3")]
+        # immediate zero-extends like an EVM code read past the end
+        assert instrs[1].push_value == 0xAA0000
+
+    def test_analyze_runs(self):
+        info = static_pass.analyze(self.CODE)
+        assert info.n_blocks == 1
+        assert info.reach_mask.shape[0] == len(self.CODE) + 1
+
+
+class TestEmptyCode:
+    def test_linear_sweep(self):
+        assert asm.disassemble(b"") == []
+
+    def test_analyze(self):
+        info = static_pass.analyze(b"")
+        assert info.n_blocks == 0
+        assert info.jump_table == {}
+        assert info.cycle_pcs == frozenset()
+        # one entry: the implicit STOP at pc 0
+        assert info.reach_mask.shape == (1,)
+
+    def test_info_for_empty_is_none(self):
+        # the gated entry point declines empty code outright
+        assert static_pass.info_for(b"") is None

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""AST repo lint for two latent-bug classes this codebase has already
+paid for (wired into tier-1 via tests/test_lint_static.py; also
+runnable standalone: ``python tools/lint_static.py [--list] [paths]``).
+
+Rule 1 — eager-backend-touch (the PR-3 class): calling
+``jax.devices()`` / ``jax.local_devices()`` / ``jax.device_count()`` /
+``jax.default_backend()`` (or their ``jax.lib`` equivalents) at module
+import time. The first backend touch is a COLLECTIVE on multi-process
+CPU after ``jax.distributed.initialize`` — an import-time touch
+silently serializes every rank to the slowest, and on single-process
+runs it pins backend selection before support/devices can configure
+it. Backend touches belong inside functions, after initialization.
+
+Rule 2 — bare-lock-near-interning (the PR-4 class): creating a
+``threading.Lock()`` / ``threading.RLock()`` inside ``mythril_tpu/smt``
+outside the sanctioned session/interning helpers. Term interning has a
+lock-free hit path with an opt-in miss lock and a generation-stamped
+session registry; an ad-hoc lock around terms either double-locks
+(ordering hazards with the pool workers) or protects nothing. New
+sites must go through the helpers — or be explicitly allowlisted.
+
+Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
+line (``<relpath>:*`` allows a whole file); ``#`` comments.
+"""
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "mythril_tpu"
+ALLOWLIST = REPO / "tools" / "lint_allowlist.txt"
+
+_BACKEND_TOUCHES = frozenset(
+    ("devices", "local_devices", "device_count", "default_backend"))
+_LOCK_NAMES = frozenset(("Lock", "RLock"))
+
+
+class Finding(NamedTuple):
+    path: str   # repo-relative
+    line: int
+    rule: str
+    detail: str
+
+    def tag(self) -> str:
+        return f"{self.path}:{self.rule}@{self.line}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.detail}"
+
+
+def _load_allowlist() -> set:
+    out = set()
+    if ALLOWLIST.exists():
+        for line in ALLOWLIST.read_text().splitlines():
+            line = line.split("#", 1)[0].strip()
+            if line:
+                out.add(line)
+    return out
+
+
+def _allowed(f: Finding, allow: set) -> bool:
+    return (f.tag() in allow
+            or f"{f.path}:{f.rule}" in allow
+            or f"{f.path}:*" in allow)
+
+
+def _is_jax_backend_call(node: ast.Call) -> bool:
+    """jax.devices(...), jax.lib...device_count(...), etc."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _BACKEND_TOUCHES:
+        return False
+    base = fn.value
+    parts = []
+    while isinstance(base, ast.Attribute):
+        parts.append(base.attr)
+        base = base.value
+    if isinstance(base, ast.Name):
+        parts.append(base.id)
+    return "jax" in parts
+
+
+def _is_lock_create(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_NAMES:
+        base = fn.value
+        return isinstance(base, ast.Name) and base.id == "threading"
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_NAMES:
+        return True
+    return False
+
+
+class _ImportTimeVisitor(ast.NodeVisitor):
+    """Walks only code that runs at import: module body, incl. nested
+    if/try/with/for blocks — but NOT function/lambda/class-method
+    bodies (class bodies DO run at import and are walked)."""
+
+    def __init__(self):
+        self.calls: List[ast.Call] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802 - ast API
+        pass  # deferred execution
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802 - ast API
+        pass
+
+    def visit_Call(self, node):  # noqa: N802 - ast API
+        self.calls.append(node)
+        self.generic_visit(node)
+
+
+def lint_file(path: Path) -> List[Finding]:
+    rel = str(path.relative_to(REPO))
+    try:
+        tree = ast.parse(path.read_text(), filename=rel)
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 0, "syntax", str(e))]
+    out: List[Finding] = []
+
+    visitor = _ImportTimeVisitor()
+    visitor.visit(tree)
+    for call in visitor.calls:
+        if _is_jax_backend_call(call):
+            out.append(Finding(
+                rel, call.lineno, "eager-backend-touch",
+                "jax backend touched at import time (collective on "
+                "multi-process CPU; move inside a function)"))
+
+    if rel.startswith("mythril_tpu/smt/"):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_lock_create(node):
+                out.append(Finding(
+                    rel, node.lineno, "bare-lock-near-interning",
+                    "threading lock created in the smt layer outside "
+                    "the sanctioned session/interning helpers "
+                    "(allowlist deliberate sites)"))
+    return out
+
+
+def lint_tree(roots=None) -> List[Finding]:
+    roots = [Path(r) for r in roots] if roots else [PACKAGE]
+    allow = _load_allowlist()
+    findings: List[Finding] = []
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for path in files:
+            if "__pycache__" in path.parts:
+                continue
+            findings.extend(
+                f for f in lint_file(path) if not _allowed(f, allow))
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    list_only = "--list" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    findings = lint_tree(paths or None)
+    for f in findings:
+        print(f)
+    if findings and not list_only:
+        print(f"lint_static: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
