@@ -1470,6 +1470,191 @@ def _smoke_static():
     return result
 
 
+def build_taint_tx_contract():
+    """Three-function dispatcher for the taint/dependence gate
+    (stage 9, docs/static_pass.md):
+
+    * ``fnJ`` (0x0a0a0a0a): calldata-tainted JUMP — the one reachable
+      ArbitraryJump issue (identity gating), and a site the taint
+      refinement must KEEP (attacker-controlled dest);
+    * ``fnW`` (0x0b0b0b0b): symbolic-slot SLOAD (``calldataload(4) &
+      3``) branched on ``== 5`` — in round 2 the select reduces to an
+      ITE over concrete leaves {0, 7}, so the static fact tier seeds
+      solves and refutes the taken arm — then a concrete
+      ``SSTORE(1, 7)``: complete write summary {1}/{7} (the fact gate
+      AND the tx-prune writer);
+    * ``fnR`` (0x0c0c0c0c): pure accessor — a concrete-condition JUMPI
+      (the taint refinement DROP site: no active module can fire on a
+      constant trigger) then ``SLOAD(2)``: complete read summary {2},
+      disjoint from fnW's writes, so (fnW, fnR)/(fnR, fnR)/(·, fnJ)
+      orderings prune in the final round (``static_tx_prunes``)."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray()
+    # dispatcher: sel = calldataload(0) >> 224
+    c += push(0) + bytes([op["CALLDATALOAD"]])
+    c += push(224) + bytes([op["SHR"]])
+    patches = []
+    for sel in (0x0A0A0A0A, 0x0B0B0B0B, 0x0C0C0C0C):
+        c += bytes([op["DUP1"]]) + push(sel, 4) + bytes([op["EQ"]])
+        patches.append(len(c))
+        c += push(0, 2) + bytes([op["JUMPI"]])
+    c += bytes([op["STOP"]])  # fallback
+    # fnJ: attacker-controlled jump dest (the kept anchor + the issue)
+    tj = len(c)
+    c += bytes([op["JUMPDEST"]])
+    c += push(0x24) + bytes([op["CALLDATALOAD"], op["JUMP"]])
+    # fnW: symbolic-slot load, ==5 branch, concrete SSTORE(1, 7)
+    tw = len(c)
+    c += bytes([op["JUMPDEST"]])
+    c += push(4) + bytes([op["CALLDATALOAD"]])
+    c += push(3) + bytes([op["AND"], op["SLOAD"]])
+    c += push(5) + bytes([op["EQ"]])
+    jw = len(c)
+    c += push(0, 2) + bytes([op["JUMPI"]])
+    c += push(7) + push(1) + bytes([op["SSTORE"], op["STOP"]])
+    w1 = len(c)
+    c[jw + 1:jw + 3] = w1.to_bytes(2, "big")
+    c += bytes([op["JUMPDEST"], op["STOP"]])
+    # fnR: concrete-condition JUMPI (the refinement drop site), then a
+    # concrete accessor read
+    tr = len(c)
+    c += bytes([op["JUMPDEST"]])
+    c += push(1)
+    jr = len(c)
+    c += push(0, 2) + bytes([op["JUMPI"], op["STOP"]])
+    r1 = len(c)
+    c[jr + 1:jr + 3] = r1.to_bytes(2, "big")
+    c += bytes([op["JUMPDEST"]])
+    c += push(2) + bytes([op["SLOAD"], op["POP"], op["STOP"]])
+    for patch, target in zip(patches, (tj, tw, tr)):
+        c[patch + 1:patch + 3] = target.to_bytes(2, "big")
+    return bytes(c)
+
+
+def _smoke_taint():
+    """Stage 9: the taint/dependence dataflow gate
+    (docs/static_pass.md, MTPU_TAINT).
+
+    The rigged two-round dispatcher run (build_taint_tx_contract,
+    modules {ArbitraryJump, TxOrigin, ArbitraryStorage} — all with
+    known trigger semantics, so the refined plane serves the set)
+    gates, on the LANE path:
+
+    * ``taint_mask_drops > 0`` — the accessor's constant-condition
+      JUMPI stopped generating its anchor bit;
+    * ``static_tx_prunes > 0`` — final-round orderings whose
+      write/read footprints are provably disjoint were excluded;
+    * ``static_facts_seeded > 0`` AND a nonzero ``hinted_solves``
+      delta — round 2's storage-ITE facts reached the screens/solver;
+    * issue identity vs ``MTPU_TAINT=0`` (the raw PR-7 pass) on the
+      lane AND host paths, with at least one issue found;
+    * off-really-off: every taint counter zero with the gate down.
+
+    Wall-clock is NOT gated (single-CPU container constraint)."""
+    from mythril_tpu.analysis import static_pass
+    from mythril_tpu.analysis.static_pass import deps as static_deps
+    from mythril_tpu.analysis.static_pass import memo as static_memo
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.analysis_args import make_cmd_args
+    from mythril_tpu.support.support_args import args as sargs
+
+    code = build_taint_tx_contract()
+    modules = ["ArbitraryJump", "TxOrigin", "ArbitraryStorage"]
+    counters = ("taint_mask_drops", "static_tx_prunes",
+                "static_facts_seeded", "hinted_solves")
+    ss = SolverStatistics()
+
+    def analyze(taint_on, tpu_lanes):
+        static_pass.FORCE_TAINT = taint_on
+        old_pf = sargs.pruning_factor
+        sargs.pruning_factor = 1.0  # fork solves exercise the hints
+        try:
+            reset_analysis_state()
+            static_memo.clear()
+            static_pass._REFINED.clear()
+            static_deps.reset_facts()
+            c0 = dict(ss.batch_counters())
+            dis = MythrilDisassembler(eth=None)
+            address, _ = dis.load_from_bytecode(code.hex(),
+                                                bin_runtime=True)
+            analyzer = MythrilAnalyzer(
+                disassembler=dis,
+                cmd_args=make_cmd_args(execution_timeout=120,
+                                       tpu_lanes=tpu_lanes),
+                strategy="bfs", address=address)
+            report = analyzer.fire_lasers(modules=list(modules),
+                                          transaction_count=2)
+            c1 = ss.batch_counters()
+            return {
+                "issues": sorted((i.swc_id, i.address, i.title)
+                                 for i in report.issues.values()),
+                "counters": {k: round(c1[k] - c0.get(k, 0), 1)
+                             for k in counters},
+            }
+        finally:
+            static_pass.FORCE_TAINT = None
+            sargs.pruning_factor = old_pf
+
+    lane_engine.PATH_HISTORY[code] = 64
+    lane_engine.FORCE_WIDTH = 64
+    old_window = lane_engine.DEFAULT_WINDOW
+    lane_engine.DEFAULT_WINDOW = 32
+    try:
+        lane_engine.warm_variant(
+            64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        lane_off = analyze(False, 64)
+        lane_on = analyze(True, 64)
+    finally:
+        lane_engine.FORCE_WIDTH = None
+        lane_engine.DEFAULT_WINDOW = old_window
+    host_off = analyze(False, 0)
+    host_on = analyze(True, 0)
+
+    lc = lane_on["counters"]
+    hc = host_on["counters"]
+    result = {
+        "lane": {k: lc[k] for k in counters},
+        "host": {k: hc[k] for k in counters},
+        "lane_issues_identical":
+            lane_on["issues"] == lane_off["issues"],
+        "host_issues_identical":
+            host_on["issues"] == host_off["issues"],
+        "off_really_off": all(
+            lane_off["counters"][k] == 0 and host_off["counters"][k] == 0
+            for k in ("taint_mask_drops", "static_tx_prunes",
+                      "static_facts_seeded")),
+        "issues": lane_on["issues"],
+    }
+    result["ok"] = bool(
+        lc["taint_mask_drops"] > 0
+        and lc["static_tx_prunes"] > 0
+        and lc["static_facts_seeded"] > 0
+        and lc["hinted_solves"] > 0
+        and hc["static_tx_prunes"] > 0
+        and hc["static_facts_seeded"] > 0
+        and result["lane_issues_identical"]
+        and result["host_issues_identical"]
+        and result["off_really_off"]
+        and len(lane_on["issues"]) > 0
+        and lane_on["issues"] == host_on["issues"]
+    )
+    return result
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
@@ -1523,6 +1708,14 @@ def bench_smoke():
        SSTORE) gates static_retired_lanes > 0,
        static_jumps_resolved > 0, and issue-set identity with
        MTPU_STATIC on vs off on both the lane and host paths. Any
+       miss exits 1;
+    9. the taint/dependence dataflow gate (_smoke_taint,
+       docs/static_pass.md): a rigged three-function dispatcher run
+       twice per path gating taint_mask_drops > 0 (a constant-trigger
+       JUMPI stopped counting), static_tx_prunes > 0 (provably
+       independent tx-pair orderings excluded), static-fact seeding
+       with nonzero hinted_solves, and issue identity with
+       MTPU_TAINT on vs off on both the lane and host paths. Any
        miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
@@ -1697,6 +1890,19 @@ def bench_smoke():
     else:
         out["static"] = {"skipped": True, "ok": True}
 
+    # stage 9: the taint/dependence dataflow gate (rigged dispatcher
+    # fixture: refined-plane drops, tx-sequence prunes, static fact
+    # seeding, issue identity vs MTPU_TAINT=0 on both paths;
+    # skippable for the quick inner loop via MTPU_SMOKE_TAINT=0)
+    if os.environ.get("MTPU_SMOKE_TAINT", "1") != "0":
+        try:
+            out["taint"] = _smoke_taint()
+        except Exception as e:
+            out["taint"] = {"ok": False, "error": type(e).__name__,
+                            "detail": str(e)[:200]}
+    else:
+        out["taint"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -1727,7 +1933,10 @@ def bench_smoke():
           and out["merge"].get("ok", False)
           # the static gate: retired lanes and resolved jumps on the
           # detector-dead-tail fixture, issue identity vs MTPU_STATIC=0
-          and out["static"].get("ok", False))
+          and out["static"].get("ok", False)
+          # the taint gate: refined-plane drops, tx-sequence prunes,
+          # static fact seeding, issue identity vs MTPU_TAINT=0
+          and out["taint"].get("ok", False))
     return 0 if ok else 1
 
 

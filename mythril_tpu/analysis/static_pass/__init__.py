@@ -19,8 +19,25 @@ summaries. Consumers:
   set-disjointness against reachable read slots;
 * migration batches ship the memoized results like verdict sidecars.
 
-Gate: ``MTPU_STATIC`` (default on; ``=0`` restores pre-pass behavior
-bit-for-bit — no analysis runs, every consumer falls back).
+The taint/dependence dataflow layer (dataflow.py, taint.py,
+selectors.py, deps.py — PR 8) rides the same pass and sidecars:
+
+* ``refined_plane`` refines the reach mask per active-module set —
+  anchor sites whose trigger operands are provably
+  attacker-independent stop counting, so lanes retire earlier through
+  the SAME seams;
+* the recovered selector map + per-function storage dependence hand
+  svm's transaction sequencer a static independence relation
+  (``static_tx_prunes``) and the dependency pruner an interprocedural
+  fast path;
+* complete write summaries open the static fact gate: bounded
+  storage-ITE chains seed the propagation pass and hint Z3
+  (``static_facts_seeded``).
+
+Gates: ``MTPU_STATIC`` (default on; ``=0`` restores pre-pass behavior
+bit-for-bit — no analysis runs, every consumer falls back) and
+``MTPU_TAINT`` (default on; ``=0`` keeps the PR-7 pass but disables
+every taint/dependence consumer bit-for-bit).
 """
 
 import logging
@@ -35,6 +52,17 @@ from . import loops as loops_mod
 from . import memo
 from . import reach as reach_mod
 from . import summaries as summaries_mod
+
+
+def _lazy_taint_mods():
+    """taint/selectors/deps import lazily: they import this package's
+    gate helpers back, and the base pass must stay importable even if
+    the dataflow layer ever grows heavier deps."""
+    from . import deps as deps_mod
+    from . import selectors as selectors_mod
+    from . import taint as taint_mod
+
+    return taint_mod, selectors_mod, deps_mod
 from .reach import (  # noqa: F401  (re-exported consumer API)
     ALL_BITS,
     MODULE_ANCHORS,
@@ -49,6 +77,10 @@ log = logging.getLogger(__name__)
 #: tri-state override for tests/bench (None = read MTPU_STATIC)
 FORCE: Optional[bool] = None
 
+#: tri-state override for the taint/dependence layer (None = read
+#: MTPU_TAINT)
+FORCE_TAINT: Optional[bool] = None
+
 #: codes beyond this many bytes skip the pass (the fixpoints are
 #: linear-ish but the mask plane and VSA state are per-pc/per-block;
 #: nothing in the corpus comes close)
@@ -60,6 +92,15 @@ def enabled() -> bool:
     if FORCE is not None:
         return FORCE
     return os.environ.get("MTPU_STATIC", "1") != "0"
+
+
+def taint_enabled() -> bool:
+    """The MTPU_TAINT gate (default on; requires the base pass)."""
+    if not enabled():
+        return False
+    if FORCE_TAINT is not None:
+        return FORCE_TAINT
+    return os.environ.get("MTPU_TAINT", "1") != "0"
 
 
 class StaticInfo(NamedTuple):
@@ -90,10 +131,28 @@ class StaticInfo(NamedTuple):
     #: block start pc for every instruction pc (mask-plane consumers
     #: index per-pc; summary consumers index per-block)
     block_of_pc: Dict[int, int]
+    # -- taint/dependence layer (PR 8; all plain picklable data, rides
+    # -- the same memo + migration sidecar) ---------------------------
+    #: the conservative CFG itself (plain namedtuples; refined planes
+    #: rebuild from it per active-module set)
+    cfg: object = None
+    #: byte pc -> taint.SiteTaint for every JUMP/JUMPI site
+    site_taints: Dict[int, object] = {}
+    #: taint fixpoint converged (False => refine nothing)
+    taint_converged: bool = False
+    #: recovered selector (uint32) -> function entry byte pc
+    selector_map: Dict[int, int] = {}
+    #: function entry byte pc -> deps.FunctionDeps
+    func_deps: Dict[int, object] = {}
+    #: whole-code complete write-slot union | None
+    all_write_slots: Optional[FrozenSet[int]] = None
+    #: every SSTORE slot AND value proved concrete (fact-seeding gate)
+    writes_complete: bool = False
 
-    def mask_at(self, byte_pc: int) -> int:
-        if 0 <= byte_pc < self.reach_mask.shape[0]:
-            return int(self.reach_mask[byte_pc])
+    def mask_at(self, byte_pc: int, plane=None) -> int:
+        table = self.reach_mask if plane is None else plane
+        if 0 <= byte_pc < table.shape[0]:
+            return int(table[byte_pc])
         return int(reach_mod._gen_bits("STOP"))  # past-end implicit STOP
 
     def block_start_of(self, byte_pc: int) -> Optional[int]:
@@ -114,6 +173,21 @@ def analyze(code: bytes) -> StaticInfo:
         for ins in b.instrs:
             block_of_pc[ins.pc] = b.start
     resolved = sum(1 for t in cfg.jump_table.values() if t is not None)
+    # the taint/dependence layer (computed unconditionally — pure in
+    # the code bytes, memoized with the rest; every CONSUMER is gated
+    # by MTPU_TAINT so =0 stays bit-for-bit off)
+    taint_mod, selectors_mod, deps_mod = _lazy_taint_mods()
+    try:
+        sites, converged = taint_mod.analyze(cfg)
+    except Exception as e:  # a refinement, never an error path
+        log.debug("taint fixpoint failed (%s); refining nothing", e)
+        sites, converged = {}, False
+    try:
+        selector_map = selectors_mod.recover(cfg)
+        func_deps = deps_mod.analyze(cfg, per_block, selector_map)
+    except Exception as e:
+        log.debug("selector/deps recovery failed (%s)", e)
+        selector_map, func_deps = {}, {}
     info = StaticInfo(
         code_hash=memo.code_hash(code),
         length=len(code),
@@ -131,6 +205,13 @@ def analyze(code: bytes) -> StaticInfo:
         reach_calls=agg.reach_calls,
         all_read_slots=agg.all_read_slots,
         block_of_pc=block_of_pc,
+        cfg=cfg,
+        site_taints=sites,
+        taint_converged=converged,
+        selector_map=selector_map,
+        func_deps=func_deps,
+        all_write_slots=agg.all_write_slots,
+        writes_complete=agg.writes_complete,
     )
     return info
 
@@ -209,6 +290,56 @@ def cycle_pcs_for(code_obj) -> Optional[FrozenSet[int]]:
     return info.cycle_pcs if info is not None else None
 
 
+# -- taint-refined reach planes (docs/static_pass.md) ------------------------
+
+#: (code_hash, frozenset(module names)) -> refined per-PC plane; a run
+#: uses ONE module set, so this stays a handful of entries per code
+_REFINED: Dict[tuple, np.ndarray] = {}
+_REFINED_CAP = 512
+
+
+def refined_plane(info: StaticInfo, module_names) -> Optional[np.ndarray]:
+    """The taint-refined reach plane for an active-module set, or None
+    when refinement cannot serve it (taint off, fixpoint not
+    converged, or a module with unknown anchor semantics). Memoized
+    per (code, module set); a fresh build bumps ``taint_mask_drops``
+    by the number of anchor sites whose gen bits dropped."""
+    if not taint_enabled() or info is None or not info.taint_converged \
+            or info.cfg is None:
+        return None
+    names = frozenset(str(n) for n in module_names)
+    if not reach_mod.refinable(names):
+        return None
+    key = (info.code_hash, names)
+    plane = _REFINED.get(key)
+    if plane is None:
+        try:
+            drops = reach_mod.refinement_drops(
+                info.cfg, info.site_taints, names)
+        except Exception as e:
+            log.debug("refinement drops failed (%s)", e)
+            return None
+        if not drops:
+            plane = info.reach_mask
+        else:
+            plane = reach_mod.reach_mask(
+                bytes(info.length), info.cfg, drops)
+            try:
+                from ...smt.solver.solver_statistics import (
+                    SolverStatistics,
+                )
+
+                SolverStatistics().bump(taint_mask_drops=len(drops))
+            except Exception:
+                pass
+            log.info("taint refinement dropped %d anchor sites (%s)",
+                     len(drops), info.code_hash[:12])
+        if len(_REFINED) >= _REFINED_CAP:
+            _REFINED.pop(next(iter(_REFINED)))
+        _REFINED[key] = plane
+    return plane
+
+
 # -- host-side state screen (svm's twin of the window-boundary retire) ------
 
 
@@ -226,13 +357,16 @@ def _pending_potential_issues(gs) -> bool:
 
 
 def state_retirable(gs, active_mask: int, final_tx: bool,
-                    info: Optional[StaticInfo] = None) -> bool:
+                    info: Optional[StaticInfo] = None,
+                    module_names=None) -> bool:
     """Would retiring this mid-transaction state lose any analysis
     value? True only when provably not: no active detector's anchor
     site is reachable from its pc, AND either no open-state terminator
     (STOP/RETURN/SELFDESTRUCT) is reachable or no later round consumes
     open states (final_tx) with nothing pending on the state. Applies
-    to top-level message-call states only."""
+    to top-level message-call states only. ``module_names`` (the
+    active detection modules) swaps in the taint-refined plane for
+    the state's own code when refinement can serve that set."""
     try:
         tx_stack = gs.transaction_stack
         if len(tx_stack) != 1 or tx_stack[-1][1] is not None:
@@ -245,10 +379,13 @@ def state_retirable(gs, active_mask: int, final_tx: bool,
             info = info_for_code_obj(gs.environment.code)
         if info is None:
             return False
+        plane = None
+        if module_names is not None:
+            plane = refined_plane(info, module_names)
         ilist = gs.environment.code.instruction_list
         pc = gs.mstate.pc
         byte_pc = ilist[pc]["address"] if pc < len(ilist) else info.length
-        mask = info.mask_at(byte_pc)
+        mask = info.mask_at(byte_pc, plane)
         if mask & int(active_mask):
             return False
         if mask & int(TERMINATOR_BIT):
@@ -260,13 +397,14 @@ def state_retirable(gs, active_mask: int, final_tx: bool,
 
 
 def screen_states(states: List, active_mask: int, final_tx: bool,
-                  counter_hook=None) -> List:
+                  counter_hook=None, module_names=None) -> List:
     """Drop statically-dead states from a host worklist batch; bumps
     the run-wide static_retired_lanes counter."""
     if not enabled() or not states:
         return states
     out = [gs for gs in states
-           if not state_retirable(gs, active_mask, final_tx)]
+           if not state_retirable(gs, active_mask, final_tx,
+                                  module_names=module_names)]
     dropped = len(states) - len(out)
     if dropped:
         try:

@@ -102,13 +102,31 @@ def _gen_bits(op: str) -> np.uint32:
     return mask
 
 
-def reach_mask(code: bytes, cfg: CFG) -> np.ndarray:
+def _site_bits(ins, drops) -> np.uint32:
+    """Gen bits of one instruction minus any taint-refinement drops at
+    its site (drops never touch TERMINATOR_BIT — only anchor-op bits
+    the refinement rules cleared)."""
+    g = _gen_bits(ins.op)
+    if drops:
+        d = drops.get(ins.pc)
+        if d:
+            g = np.uint32(g & ~np.uint32(d & ~int(TERMINATOR_BIT)))
+    return g
+
+
+def reach_mask(code: bytes, cfg: CFG, drops=None) -> np.ndarray:
     """(len(code)+1,) uint32 table of reachable anchor classes per PC.
 
     Non-instruction offsets (bytes inside PUSH immediates) hold
     ALL_BITS — no lane legitimately sits there, and an illegitimate
     one must never be retired on a garbage lookup. Index len(code) is
-    the implicit trailing STOP."""
+    the implicit trailing STOP.
+
+    ``drops`` ({byte pc: uint32 bits to clear from that site's gen
+    set}) is the taint-refinement hook (taint.py / refined_mask): a
+    site whose trigger operands are provably attacker-independent
+    stops generating its anchor bit, and the backward fixpoint then
+    computes reachability of *influenceable* anchors only."""
     n = len(code)
     table = np.full(n + 1, ALL_BITS, dtype=np.uint32)
     table[n] = _gen_bits("STOP")
@@ -120,7 +138,7 @@ def reach_mask(code: bytes, cfg: CFG) -> np.ndarray:
     for bi, block in enumerate(cfg.blocks):
         g = np.uint32(0)
         for ins in block.instrs:
-            g |= _gen_bits(ins.op)
+            g |= _site_bits(ins, drops)
         # a block that runs off the end of code executes the implicit
         # STOP (blocks.recover_blocks gives it no successors)
         if not cfg.succ[bi] and block.last.op not in (
@@ -156,6 +174,45 @@ def reach_mask(code: bytes, cfg: CFG) -> np.ndarray:
             out |= _gen_bits("STOP")
         mask = out
         for ins in reversed(block.instrs):
-            mask = mask | _gen_bits(ins.op)
+            mask = mask | _site_bits(ins, drops)
             table[ins.pc] = mask
     return table
+
+
+# -- taint-refined planes ----------------------------------------------------
+
+
+def refinable(module_names) -> bool:
+    """May the taint-refined plane serve this active-module set? Only
+    when every module's anchor semantics are known (MODULE_ANCHORS):
+    an unknown module could anchor on JUMP/JUMPI with a trigger
+    predicate the refinement rules do not model."""
+    return all(name in MODULE_ANCHORS for name in module_names)
+
+
+def refinement_drops(cfg: CFG, sites, module_names) -> dict:
+    """{byte pc: uint32 bits to clear} for the active-module set: an
+    anchor-op bit drops at a site when NO active module anchored on
+    that op can fire there under the converged operand taints
+    (taint.module_can_fire). Requires ``refinable(module_names)``."""
+    from . import taint as taint_mod
+
+    drops = {}
+    anchored = {}  # op -> [module names anchored on it]
+    for name in module_names:
+        for op in MODULE_ANCHORS.get(name, ()):
+            anchored.setdefault(op, []).append(name)
+    for block in cfg.blocks:
+        last = block.last
+        if last.op not in ("JUMP", "JUMPI"):
+            continue
+        st = sites.get(last.pc)
+        if st is None:
+            continue
+        mods = anchored.get(last.op)
+        if not mods:
+            continue
+        if not any(taint_mod.module_can_fire(m, last.op, st)
+                   for m in mods):
+            drops[last.pc] = int(1 << OP_BITS[last.op])
+    return drops

@@ -36,6 +36,10 @@ class BlockSummary(NamedTuple):
     #: concrete SSTORE slots in this block, or None when one widened
     writes: Optional[FrozenSet[int]]
     has_call: bool
+    #: concrete SSTORE VALUES in this block, or None when one widened
+    #: (the fact-seeding gate, deps.py: complete write values keep
+    #: storage select chains concrete)
+    write_values: Optional[FrozenSet[int]] = frozenset()
 
 
 def summarize_blocks(cfg: CFG) -> Dict[int, BlockSummary]:
@@ -44,6 +48,7 @@ def summarize_blocks(cfg: CFG) -> Dict[int, BlockSummary]:
         stack = list(cfg.entry_stacks.get(bi, []))
         reads: Optional[set] = set()
         writes: Optional[set] = set()
+        wvals: Optional[set] = set()
         has_call = False
         for ins in block.instrs:
             if ins.op in ("SLOAD", "SSTORE"):
@@ -56,13 +61,20 @@ def summarize_blocks(cfg: CFG) -> Dict[int, BlockSummary]:
                         writes = None
                 elif target is not None:
                     target.update(slot)
+                if ins.op == "SSTORE":
+                    val = stack[-2] if len(stack) >= 2 else TOP
+                    if val is TOP:
+                        wvals = None
+                    elif wvals is not None:
+                        wvals.update(val)
             elif ins.op in _CALL_OPS:
                 has_call = True
             transfer(stack, ins)
         out[block.start] = BlockSummary(
             frozenset(reads) if reads is not None else None,
             frozenset(writes) if writes is not None else None,
-            has_call)
+            has_call,
+            frozenset(wvals) if wvals is not None else None)
     return out
 
 
@@ -70,6 +82,11 @@ class ReachSummaries(NamedTuple):
     reach_reads: Dict[int, Optional[FrozenSet[int]]]
     reach_calls: Dict[int, bool]
     all_read_slots: Optional[FrozenSet[int]]
+    #: whole-code complete write-slot union | None (deps.py)
+    all_write_slots: Optional[FrozenSet[int]] = None
+    #: every SSTORE site's slot AND value proved concrete — the
+    #: fact-seeding gate (deps.register_code)
+    writes_complete: bool = False
 
 
 def aggregate(cfg: CFG, per_block: Dict[int, BlockSummary]
@@ -104,7 +121,17 @@ def aggregate(cfg: CFG, per_block: Dict[int, BlockSummary]
             all_reads = None
             break
         all_reads = all_reads | br
+    all_writes: Optional[frozenset] = frozenset()
+    writes_complete = True
+    for bi in range(nb):
+        summ = per_block[cfg.blocks[bi].start]
+        if summ.writes is None or all_writes is None:
+            all_writes = None
+        else:
+            all_writes = all_writes | summ.writes
+        if summ.writes is None or summ.write_values is None:
+            writes_complete = False
     return ReachSummaries(
         {cfg.blocks[bi].start: reads[bi] for bi in range(nb)},
         {cfg.blocks[bi].start: calls[bi] for bi in range(nb)},
-        all_reads)
+        all_reads, all_writes, writes_complete)

@@ -1559,6 +1559,9 @@ class LaneEngine:
         self.static_active_mask = None
         self.static_final_tx = False
         self.static_jump_patch_ok = False
+        #: active-module names for the taint-refined reach plane
+        #: (docs/static_pass.md; None = refinement off, raw mask)
+        self.static_module_names = None
         # in-place SHA3 resume: off whenever a detector hooks SHA3
         # (the hook must fire host-side; no adapter lifts SHA3 today)
         self.resume_on = "SHA3" not in set(blocked_ops or ())
@@ -2595,6 +2598,21 @@ class LaneEngine:
             return
         from ..analysis.static_pass import TERMINATOR_BIT
 
+        # taint-refined plane for the active-module set (PR 8): anchor
+        # sites whose trigger operands are provably
+        # attacker-independent stop holding lanes alive; None falls
+        # back to the raw reach mask (MTPU_TAINT=0, unconverged taint
+        # fixpoint, or a module with unknown trigger semantics)
+        plane = None
+        if self.static_module_names is not None:
+            try:
+                from ..analysis import static_pass
+
+                plane = static_pass.refined_plane(
+                    info, self.static_module_names)
+            except Exception:
+                plane = None
+
         active = int(active)
         final_tx = bool(self.static_final_tx)
         excluded = dead_set | set(kill) | {r[0] for r in resumes}
@@ -2607,7 +2625,7 @@ class LaneEngine:
                 continue
             if ctx.promos:
                 continue  # pending drain promotions: must materialize
-            mask = info.mask_at(int(pcs[lane]))
+            mask = info.mask_at(int(pcs[lane]), plane)
             if mask & active:
                 continue
             if mask & int(TERMINATOR_BIT):

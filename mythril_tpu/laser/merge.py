@@ -525,6 +525,22 @@ def merge_open_states(open_states: List) -> List:
         survivor._annotations = anns
         if plan.new_conds is not None:
             survivor.constraints = Constraints(list(plan.new_conds))
+        # static tx-prune tag (svm._tag_last_function): the survivor
+        # now represents every dropped disjunct, so the
+        # previous-function tag only survives when ALL of them agree —
+        # else the next round's independence screen must not prune on
+        # a function the merged-away disjunct never ran
+        try:
+            tag = getattr(survivor, "_mtpu_last_fentry", None)
+            for mi in plan.dropped:
+                other = getattr(open_states[g[mi]],
+                                "_mtpu_last_fentry", None)
+                if other != tag:
+                    tag = None
+                    break
+            survivor._mtpu_last_fentry = tag
+        except Exception:
+            pass
         for mi, reason in plan.dropped.items():
             drop[g[mi]] = reason
             if reason == "merged":

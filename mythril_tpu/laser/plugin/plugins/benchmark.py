@@ -161,6 +161,22 @@ class BenchmarkPlugin(LaserPlugin):
                     counters["static_retired_lanes"],
                     counters["static_pruner_skips"],
                 )
+            # taint/dependence dataflow layer (docs/static_pass.md):
+            # refined-plane anchor drops, tx-pair orderings excluded
+            # by the static independence screen, implied facts seeded
+            # ahead of solves, and memo-cap evictions
+            if counters["taint_mask_drops"] or \
+                    counters["static_tx_prunes"] or \
+                    counters["static_facts_seeded"] or \
+                    counters["static_memo_evictions"]:
+                log.info(
+                    "Static taint/deps: mask_drops=%d tx_prunes=%d "
+                    "facts_seeded=%d memo_evictions=%d",
+                    counters["taint_mask_drops"],
+                    counters["static_tx_prunes"],
+                    counters["static_facts_seeded"],
+                    counters["static_memo_evictions"],
+                )
             # migration-bus verdict shipping (docs/work_stealing.md):
             # proofs exported with stolen batches / replayed from a
             # victim's sidecar before a resume

@@ -162,6 +162,19 @@ class DependencyPruner(LaserPlugin):
             out.add(v)
         return out
 
+    def _function_entry(self, annotation: DependencyAnnotation,
+                        static_info) -> int:
+        """The recovered function entry this transaction's path routed
+        through, or None. The dispatcher visits the entry within its
+        first few jump targets, so the scan is bounded."""
+        func_deps = getattr(static_info, "func_deps", None)
+        if not func_deps:
+            return None
+        for addr in annotation.path[:8]:
+            if addr in func_deps:
+                return addr
+        return None
+
     def _static_no_rerun(self, address: int,
                          annotation: DependencyAnnotation,
                          static_info) -> bool:
@@ -175,28 +188,54 @@ class DependencyPruner(LaserPlugin):
         it. Reachable reads over-approximate every slot value any
         execution through this block can load (the value-set analysis'
         soundness contract), so a concrete write outside the set can
-        never alias a recorded read."""
+        never alias a recorded read.
+
+        PR 8 adds the INTERPROCEDURAL tier first: when the path's
+        function entry is recovered, the whole-function aggregate
+        (deps.FunctionDeps — reads of every block reachable from the
+        entry, a superset of reads reachable from `address`) answers
+        the same question without the per-block read table, and the
+        block-address conservatism check narrows from the whole-code
+        read union to the function's own reads."""
         if static_info is None:
-            return False
-        rr = static_info.reach_reads.get(address)
-        if rr is None or static_info.reach_calls.get(address, True):
             return False
         writes = self._concrete_values(
             annotation.get_storage_write_cache(self.iteration - 1))
         if writes is None or not writes:
             return False
-        # check (3)'s conservatism, statically: the block-address-
-        # as-read-slot rule can only fire when `address` is a read
-        # slot SOMEWHERE — the complete whole-code read union rules
-        # that out without touching term hashes
-        all_reads = static_info.all_read_slots
-        if all_reads is None or address in all_reads:
-            return False
         loaded = self._concrete_values(annotation.storage_loaded)
-        if loaded is None:
+        if loaded is None or writes & loaded:
             return False
-        if writes & rr or writes & loaded:
-            return False
+
+        hit = False
+        try:
+            from ....analysis import static_pass
+
+            taint_on = static_pass.taint_enabled()
+        except Exception:
+            taint_on = False
+        if taint_on:
+            entry = self._function_entry(annotation, static_info)
+            fd = static_info.func_deps.get(entry) \
+                if entry is not None else None
+            if fd is not None and fd.reads is not None \
+                    and not fd.has_effects \
+                    and address not in fd.reads \
+                    and not (writes & fd.reads):
+                hit = True
+        if not hit:
+            rr = static_info.reach_reads.get(address)
+            if rr is None or static_info.reach_calls.get(address, True):
+                return False
+            # check (3)'s conservatism, statically: the block-address-
+            # as-read-slot rule can only fire when `address` is a read
+            # slot SOMEWHERE — the complete whole-code read union rules
+            # that out without touching term hashes
+            all_reads = static_info.all_read_slots
+            if all_reads is None or address in all_reads:
+                return False
+            if writes & rr:
+                return False
         try:
             from ....smt.solver.solver_statistics import SolverStatistics
 

@@ -142,6 +142,19 @@ class InstructionProfiler(LaserPlugin):
                         counters["static_retired_lanes"],
                         counters["static_pruner_skips"],
                     ))
+            # taint/dependence dataflow layer (docs/static_pass.md)
+            if counters["taint_mask_drops"] or \
+                    counters["static_tx_prunes"] or \
+                    counters["static_facts_seeded"] or \
+                    counters["static_memo_evictions"]:
+                lines.append(
+                    "Static taint/deps: mask_drops={} tx_prunes={} "
+                    "facts_seeded={} memo_evictions={}".format(
+                        counters["taint_mask_drops"],
+                        counters["static_tx_prunes"],
+                        counters["static_facts_seeded"],
+                        counters["static_memo_evictions"],
+                    ))
             # migration-bus verdict shipping (docs/work_stealing.md)
             if counters["verdicts_shipped"] or \
                     counters["verdicts_replayed"]:

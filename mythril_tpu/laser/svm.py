@@ -277,6 +277,15 @@ class LaserEVM:
             # static-retire round context: open states of the LAST
             # round seed nothing (docs/static_pass.md)
             self._static_final_tx = i + 1 >= self.transaction_count
+            # static tx-sequence pruning (docs/static_pass.md): an
+            # open state that finished the previous round inside
+            # function f skips next-round functions g the
+            # interprocedural dependence relation proves blind to f's
+            # effects — the entry wave appends selector-exclusion
+            # constraints per state (transaction/entry.py). Stands
+            # down when the caller pinned explicit sequences.
+            if func_hashes is None:
+                self._static_tx_prune_screen(address)
             # round context for the migration bus's MID-ROUND yield
             # (parallel/migrate.py): states finishing round i await
             # round i+1, so a slice exported while round i still runs
@@ -322,6 +331,51 @@ class LaserEVM:
                                  address)
         self.start_round = 0  # a later sym_exec must not skip rounds
         self.executed_transactions = True
+
+    def _static_tx_prune_screen(self, address) -> None:
+        """Pre-round static independence screen (docs/static_pass.md,
+        deps.excluded_selectors): per open state, selectors the next
+        transaction may skip because the previous transaction's
+        function provably cannot influence them. The exclusions are
+        stashed on the world state; EntryWave.spawn_call turns them
+        into calldata constraints. Counted as ``static_tx_prunes``.
+        Sound per the two-rule argument in deps.py — final-round
+        orderings are redundant duplicates of the sibling branch that
+        ran g from f's pre-state, non-final orderings only prune one
+        side of a provably commuting pair."""
+        try:
+            from ..analysis import static_pass
+            from ..analysis.static_pass import deps as deps_mod
+
+            if not static_pass.taint_enabled():
+                return
+            total = 0
+            final = bool(self._static_final_tx)
+            for ws in self.open_states:
+                try:
+                    ws._mtpu_excluded_selectors = None
+                    account = ws[address]
+                    info = static_pass.info_for_code_obj(account.code)
+                    if info is None:
+                        continue
+                    deps_mod.register_code(info)  # fact-seeding gate
+                    prev = getattr(ws, "_mtpu_last_fentry", None)
+                    excl = deps_mod.excluded_selectors(info, prev, final)
+                    if excl:
+                        ws._mtpu_excluded_selectors = excl
+                        total += len(excl)
+                except Exception:
+                    continue
+            if total:
+                from ..smt.solver.solver_statistics import (
+                    SolverStatistics,
+                )
+
+                SolverStatistics().bump(static_tx_prunes=total)
+                log.info("static independence screen excluded %d "
+                         "tx-pair orderings this round", total)
+        except Exception as e:  # a screen, never an error path
+            log.debug("static tx-prune screen failed: %s", e)
 
     def _submit_open_state_screen(self):
         """Round-boundary async reachability prefetch
@@ -587,6 +641,7 @@ class LaserEVM:
         # state could carry an undelivered issue).
         static_mask = None
         static_patch_ok = False
+        static_module_names = None
         try:
             from ..analysis import static_pass
 
@@ -613,6 +668,12 @@ class LaserEVM:
                     static_patch_ok = all(
                         type(m).__name__ != "ArbitraryJump"
                         for m in active_mods)
+                    # taint-refined planes key on the module set
+                    # (docs/static_pass.md): refined_plane serves it
+                    # only when every module's trigger semantics are
+                    # known, and returns None otherwise
+                    static_module_names = frozenset(
+                        type(m).__name__ for m in active_mods)
         except Exception as e:
             log.debug("static pass context unavailable: %s", e)
         static_final = bool(self._static_final_tx)
@@ -677,6 +738,7 @@ class LaserEVM:
                 engine.static_active_mask = static_mask
                 engine.static_final_tx = static_final
                 engine.static_jump_patch_ok = static_patch_ok
+                engine.static_module_names = static_module_names
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(
@@ -691,7 +753,8 @@ class LaserEVM:
                     from ..analysis import static_pass
 
                     parked = static_pass.screen_states(
-                        parked, static_mask, static_final)
+                        parked, static_mask, static_final,
+                        module_names=static_module_names)
                 except Exception as e:
                     log.debug("static state screen failed: %s", e)
             run = engine.last_run_stats
@@ -1144,9 +1207,46 @@ class LaserEVM:
                 hook(global_state)
             except PluginSkipWorldState:
                 return
+        self._tag_last_function(global_state)
         if self._path_delay:
             time.sleep(self._path_delay)
         self.open_states.append(global_state.world_state)
+
+    def _tag_last_function(self, global_state: GlobalState) -> None:
+        """Static tx-prune context (docs/static_pass.md): remember
+        WHICH function entry this finished transaction's path routed
+        through, so the next round's pre-screen can consult the
+        interprocedural independence relation. The tag rides the open
+        world state; the round-boundary merge drops it unless every
+        merged disjunct agrees (laser/merge.py)."""
+        try:
+            from ..analysis import static_pass
+
+            if not static_pass.taint_enabled():
+                return
+            ws = global_state.world_state
+            ws._mtpu_last_fentry = None
+            tx = global_state.current_transaction
+            from .transaction import MessageCallTransaction
+
+            if not isinstance(tx, MessageCallTransaction):
+                return
+            code = global_state.environment.code
+            rev = getattr(code, "_mtpu_name_to_entry", None)
+            if rev is None:
+                rev = {}
+                for addr, fname in getattr(
+                        code, "address_to_function_name", {}).items():
+                    # an ambiguous name (two entries) must tag nothing
+                    rev[fname] = None if fname in rev else addr
+                try:
+                    code._mtpu_name_to_entry = rev
+                except Exception:
+                    pass
+            ws._mtpu_last_fentry = rev.get(
+                global_state.environment.active_function_name)
+        except Exception:
+            pass
 
     # -- CFG ----------------------------------------------------------------
 

@@ -116,8 +116,32 @@ class EntryWave:
                 f"call_value{tid}", 256
             ),
         )
-        self.enqueue(tx, self._selector_constraints(calldata))
+        constraints = self._selector_constraints(calldata)
+        constraints += self._exclusion_constraints(world_state, calldata)
+        self.enqueue(tx, constraints)
         return tx
+
+    def _exclusion_constraints(self, world_state, calldata) -> List[Bool]:
+        """Static tx-sequence pruning (docs/static_pass.md): the
+        pre-round screen stashed selectors this state's next
+        transaction may skip. Each exclusion keeps every other path —
+        including the fallback (size < 4) — alive: the constraint is
+        ``size < 4 OR some selector byte differs``."""
+        excluded = getattr(world_state, "_mtpu_excluded_selectors",
+                           None)
+        if not excluded:
+            return []
+        out = []
+        for sel in excluded:
+            sel_bytes = int(sel).to_bytes(4, "big")
+            alts = [calldata.size
+                    < FUNCTION_HASH_BYTE_LENGTH]
+            alts += [
+                calldata[i] != symbol_factory.BitVecVal(b, 8)
+                for i, b in enumerate(sel_bytes)
+            ]
+            out.append(Or(*alts))
+        return out
 
     def _selector_constraints(self, calldata) -> List[Bool]:
         """Constrain the selector bytes to the wave's allowed function

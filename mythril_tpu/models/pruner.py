@@ -45,8 +45,22 @@ def _interval_infeasible(constraints) -> bool:
     prefix's variable bounds (tier 3) and records refutations so
     descendant sets across windows and call sites die by ancestor
     subsumption. Falls back to plain state_infeasible when the cache is
-    disabled."""
+    disabled.
+
+    The static-fact tier runs first (PR 8,
+    analysis/static_pass/deps.static_eq_refuted): an equality pinning
+    a storage-ITE tree to a constant outside its leaf set is UNSAT by
+    term structure alone — a hole INSIDE the interval hull neither
+    the bounds walk nor tier 3 can see, answered with zero solver or
+    interval work."""
     raws = [getattr(c, "raw", c) for c in constraints]
+    try:
+        from ..analysis.static_pass import deps as static_deps
+
+        if static_deps.static_eq_refuted(raws):
+            return True
+    except Exception:
+        pass
     try:
         from ..smt.solver import verdicts
 

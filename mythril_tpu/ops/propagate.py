@@ -957,6 +957,42 @@ def _state_terms(enc: EncodedDAG, s: int):
 # ---------------------------------------------------------------------------
 
 
+def _inject_static_seeds(enc: EncodedDAG) -> None:
+    """Meet the static storage-ITE candidate hulls
+    (analysis/static_pass/deps.static_seed_rows) into the encoding's
+    shared init tables BEFORE the fixpoint/interval screen runs: the
+    hull is implied by the term structure (an ITE's value is always
+    one of its leaves), so the tighter seed removes only states the
+    term provably cannot reach — same soundness contract as the
+    syntactic bound seeds. No-shape change, so jit variants are
+    untouched. Counted as ``static_facts_seeded``."""
+    try:
+        from ..analysis.static_pass import deps as static_deps
+
+        rows = static_deps.static_seed_rows(enc)
+    except Exception:
+        return
+    if not rows:
+        return
+    try:
+        from .intervals import _word
+
+        init_lo = np.asarray(enc.init_lo).copy()
+        init_hi = np.asarray(enc.init_hi).copy()
+        for i, (lo, hi) in rows.items():
+            if i >= init_lo.shape[0]:
+                continue
+            init_lo[i] = _word(lo)
+            init_hi[i] = _word(hi)
+        enc.init_lo = jnp.asarray(init_lo)
+        enc.init_hi = jnp.asarray(init_hi)
+        from ..smt.solver.solver_statistics import SolverStatistics
+
+        SolverStatistics().bump(static_facts_seeded=len(rows))
+    except Exception:  # a seed, never an error path
+        log.debug("static seed injection failed", exc_info=True)
+
+
 def run(enc: EncodedDAG):
     """(keep, tables) for an encoded wave, or None when the plan falls
     outside the whole-kernel envelope (caller uses the forward interval
@@ -980,6 +1016,7 @@ def prefilter_feasible(assertion_sets: Sequence[Sequence]) -> np.ndarray:
 
     sets = [[getattr(t, "raw", t) for t in s] for s in assertion_sets]
     enc = linearize(sets)
+    _inject_static_seeds(enc)
     got = run(enc)
     if got is None:
         from .intervals import eval_feasible

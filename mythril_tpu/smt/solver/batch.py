@@ -86,14 +86,26 @@ def _propagate_prescreen(norm, verdicts, registry, ss) -> None:
 
 
 def _hints_for(vc, work) -> list:
-    """Harvested propagation facts for a query (implied consequences
-    of `work` — asserting them first cannot change the verdict)."""
-    if vc is None or not work:
+    """Harvested propagation facts for a query plus the static
+    storage-ITE facts (analysis/static_pass/deps.py). Both kinds are
+    implied consequences — the propagation facts of the asserted set,
+    the static facts of the term structure alone — so asserting them
+    first cannot change the verdict."""
+    if not work:
         return []
+    hints = []
+    if vc is not None:
+        try:
+            hints = list(vc.facts_for(tid_key(work)))
+        except Exception:
+            hints = []
     try:
-        return list(vc.facts_for(tid_key(work)))
+        from ...analysis.static_pass import deps as static_deps
+
+        hints += static_deps.static_hints_for_set(work)
     except Exception:
-        return []
+        pass
+    return hints
 
 
 def order_by_prefix(term_sets: Sequence[Sequence]) -> List[int]:
@@ -286,6 +298,8 @@ def _discharge_serial(
         try:
             ctx = core.check(hints + list(work), timeout_s=timeout_s,
                              conflict_budget=conflict_budget)
+        except (KeyboardInterrupt, MemoryError):
+            raise  # fatal, never a degrade (the _device_failed class)
         except Exception as e:  # degraded, never wrong: keep the query
             log.debug("batch discharge solve failed: %s", e)
             verdicts[i] = UNKNOWN
@@ -414,6 +428,8 @@ def _discharge_pooled(pool, term_sets, timeout_s, conflict_budget,
             try:
                 ctx = pool.solve_query(hints + list(work), timeout_s,
                                        conflict_budget)
+            except (KeyboardInterrupt, MemoryError):
+                raise  # fatal, never a degrade
             except Exception as e:  # degraded, never wrong
                 log.debug("pooled discharge solve failed: %s", e)
                 return (UNKNOWN, None)
@@ -471,6 +487,8 @@ def _serial_requery(i, norm, registry, vc, timeout_s, conflict_budget,
     try:
         ctx = core.check(hints + list(work), timeout_s=timeout_s,
                          conflict_budget=conflict_budget)
+    except (KeyboardInterrupt, MemoryError):
+        raise  # fatal, never a degrade
     except Exception as e:
         log.debug("serial requery failed: %s", e)
         return (UNKNOWN, None)

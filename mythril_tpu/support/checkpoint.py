@@ -220,8 +220,13 @@ def load_verdict_sidecar(path) -> list:
 def save_static_sidecar(path, entries) -> bool:
     """Write a migration batch's static-pass sidecar: memoized
     analysis/static_pass.StaticInfo entries (plain picklable data — no
-    terms, so no flat-table framing needed). Best-effort, like the
-    verdict sidecar: a failure must never block the batch."""
+    terms, so no flat-table framing needed). The taint/dependence
+    layer's products (PR 8: cfg, site taints, selector map, function
+    deps, write-completeness) are ordinary StaticInfo fields and ship
+    with the same pickle — a thief computes refined planes and the
+    tx-prune relation from them without re-running any fixpoint.
+    Best-effort, like the verdict sidecar: a failure must never block
+    the batch."""
     try:
         path = str(path)
         fd, tmp = tempfile.mkstemp(
@@ -240,12 +245,25 @@ def save_static_sidecar(path, entries) -> bool:
 
 def load_static_sidecar(path) -> list:
     """Inverse of save_static_sidecar; absent/corrupt loads as empty
-    (the thief re-analyzes — milliseconds, never wrong)."""
+    (the thief re-analyzes — milliseconds, never wrong). Entries from
+    a build predating the taint layer (no ``taint_converged`` field)
+    are dropped rather than adopted: their namedtuple shape resolves
+    the new consumers' getattr probes to class defaults, which is
+    sound, but a mixed-build fleet mid-deploy should re-derive from
+    bytes instead of pinning stale shapes into the memo."""
     try:
         if not os.path.exists(str(path)):
             return []
         with open(str(path), "rb") as f:
-            return list(pickle.load(f))
+            entries = list(pickle.load(f))
+        kept = [e for e in entries
+                if hasattr(e, "code_hash") and hasattr(e, "reach_mask")
+                and hasattr(e, "taint_converged")]
+        if len(kept) != len(entries):
+            log.info("static sidecar: dropped %d pre-taint-layer "
+                     "entries (thief re-analyzes)",
+                     len(entries) - len(kept))
+        return kept
     except Exception as e:
         log.warning("static sidecar load failed (%s); re-analyzing", e)
         return []

@@ -93,3 +93,63 @@ def test_allowlist_suppresses(tmp_path):
         assert lint_static.lint_tree([path]) == []
     finally:
         lint_static.REPO, lint_static.ALLOWLIST = old_repo, old_allow
+
+
+def test_broad_except_in_solver_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/smt/solver/bad.py", """\
+        def solve(q):
+            try:
+                return check(q)
+            except Exception:
+                return None
+    """)
+    assert [f.rule for f in findings] == ["broad-except-swallows-fatal"]
+    assert findings[0].line == 4
+
+
+def test_bare_except_in_ops_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/ops/bad.py", """\
+        def screen(w):
+            try:
+                return run(w)
+            except:
+                return None
+    """)
+    assert [f.rule for f in findings] == ["broad-except-swallows-fatal"]
+
+
+def test_broad_except_with_fatal_guard_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/ops/good.py", """\
+        def screen(w):
+            try:
+                return run(w)
+            except (KeyboardInterrupt, MemoryError):
+                raise
+            except Exception as e:
+                log(e)
+                return None
+    """)
+    assert findings == []
+
+
+def test_broad_except_that_reraises_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/smt/solver/good.py", """\
+        def solve(q):
+            try:
+                return check(q)
+            except Exception:
+                cleanup()
+                raise
+    """)
+    assert findings == []
+
+
+def test_broad_except_outside_rule3_roots_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/ok2.py", """\
+        def f():
+            try:
+                return g()
+            except Exception:
+                return None
+    """)
+    assert findings == []

@@ -1,0 +1,604 @@
+"""Taint/dependence dataflow layer (analysis/static_pass/dataflow.py,
+taint.py, selectors.py, deps.py — docs/static_pass.md).
+
+Covers:
+
+* taint site units: constant triggers drop, calldata/origin/storage
+  flows keep, unresolved jumps force TOP;
+* the randomized taint-SOUNDNESS property: generated structured codes
+  are CONCRETELY executed under two valuations that pin every taint
+  source to different values — every JUMPI condition the analysis
+  marks untainted must evaluate identically (attacker-independence is
+  exactly two-run value equality), and source-free conditions must be
+  marked clean (precision on the modeled vocabulary);
+* selector recovery against hand-assembled dispatchers (SHR form,
+  DIV+SWAP form, a GT binary-search split, dispatcher-free code);
+* the interprocedural independence relation and the tx-prune rules
+  (final-round one-sided, non-final commuting + canonical order,
+  effectful/balance-reading blockers);
+* static fact seeding: ITE-leaf candidates, the EQ refutation fast
+  path, implied-fact minting, and the MTPU_TAINT off switch;
+* the memo LRU regression (PR 8 satellite): sidecar imports fill cold
+  slots without evicting hot in-process entries, gets bump recency,
+  and cap evictions count.
+"""
+
+import random
+
+import pytest
+
+from mythril_tpu.analysis import static_pass
+from mythril_tpu.analysis.static_pass import deps as deps_mod
+from mythril_tpu.analysis.static_pass import memo as static_memo
+from mythril_tpu.analysis.static_pass import selectors as sel_mod
+from mythril_tpu.analysis.static_pass import taint as taint_mod
+from mythril_tpu.analysis.static_pass.deps import FunctionDeps
+from mythril_tpu.analysis.static_pass.reach import OP_BITS
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+WORD = (1 << 256) - 1
+
+
+def push(v, n=1):
+    return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+
+@pytest.fixture(autouse=True)
+def _taint_on():
+    old = static_pass.FORCE_TAINT
+    static_pass.FORCE_TAINT = True
+    static_pass._REFINED.clear()
+    deps_mod.reset_facts()
+    yield
+    static_pass.FORCE_TAINT = old
+    static_pass._REFINED.clear()
+    deps_mod.reset_facts()
+
+
+# -- taint site units --------------------------------------------------------
+
+
+def _converging_jumpi(cond_code: bytes) -> bytes:
+    """cond_code leaves one value; JUMPI whose target IS the
+    fallthrough (both arms converge), then STOP."""
+    c = bytearray(cond_code)
+    j = len(c)
+    c += push(0, 2) + bytes([OP["JUMPI"]])
+    d = len(c)
+    c[j + 1:j + 3] = d.to_bytes(2, "big")
+    c += bytes([OP["JUMPDEST"], OP["STOP"]])
+    return bytes(c)
+
+
+def _site(info, op="JUMPI"):
+    sites = [(pc, st) for pc, st in info.site_taints.items()]
+    assert sites, "fixture must contain a jump site"
+    return sites[0][1] if len(sites) == 1 else dict(sites)
+
+
+def test_constant_condition_is_clean():
+    info = static_pass.analyze(_converging_jumpi(push(1)))
+    st = _site(info)
+    assert st.cond == taint_mod.CLEAN and st.dest == taint_mod.CLEAN
+
+
+def test_calldata_condition_keeps_bit():
+    info = static_pass.analyze(
+        _converging_jumpi(push(0) + bytes([OP["CALLDATALOAD"]])))
+    st = _site(info)
+    assert st.cond == taint_mod.CALLDATA
+
+
+def test_origin_through_memory_keeps_origin_bit():
+    cond = bytes([OP["ORIGIN"]]) + push(0) + bytes([OP["MSTORE"]]) \
+        + push(0) + bytes([OP["MLOAD"]])
+    info = static_pass.analyze(_converging_jumpi(cond))
+    st = _site(info)
+    assert st.cond is not taint_mod.TOP
+    assert st.cond & taint_mod.ORIGIN
+
+
+def test_sload_condition_carries_sload_bit():
+    cond = push(3) + bytes([OP["SLOAD"]])
+    info = static_pass.analyze(_converging_jumpi(cond))
+    st = _site(info)
+    assert st.cond & taint_mod.SLOAD
+
+
+def test_unmodeled_op_is_top():
+    cond = bytes([OP["GAS"]])
+    info = static_pass.analyze(_converging_jumpi(cond))
+    st = _site(info)
+    assert st.cond is taint_mod.TOP
+
+
+def test_unresolved_jump_forces_top_at_targets():
+    # entry jumps to a data-dependent dest: the block behind the
+    # JUMPDEST receives the TOP state, so a slot INHERITED from the
+    # caller (DUP1 on the empty tracked stack) is TOP — after SWAP1 it
+    # becomes the JUMPI dest, and the refined plane must keep the site
+    # (ArbitraryJump can fire on an unknown dest)
+    c = bytearray()
+    c += push(0) + bytes([OP["CALLDATALOAD"], OP["JUMP"]])
+    d = len(c)
+    c += bytes([OP["JUMPDEST"], OP["DUP1"]])
+    j = len(c)
+    c += push(0, 2) + bytes([OP["SWAP1"], OP["JUMPI"], OP["STOP"]])
+    t = len(c)
+    c[j + 1:j + 3] = t.to_bytes(2, "big")
+    c += bytes([OP["JUMPDEST"], OP["STOP"]])
+    info = static_pass.analyze(bytes(c))
+    st = info.site_taints[j + 4]
+    assert st.dest is taint_mod.TOP  # inherited through the TOP edge
+    plane = static_pass.refined_plane(info, ["ArbitraryJump"])
+    assert int(plane[d]) & (1 << OP_BITS["JUMPI"])
+
+
+def test_refined_plane_drops_and_keeps():
+    # one clean JUMPI, one calldata JUMP: ArbitraryJump set drops the
+    # former's bit and keeps the latter's
+    c = bytearray(push(1))
+    j = len(c)
+    c += push(0, 2) + bytes([OP["JUMPI"], OP["STOP"]])
+    t = len(c)
+    c[j + 1:j + 3] = t.to_bytes(2, "big")
+    c += bytes([OP["JUMPDEST"]])
+    c += push(0) + bytes([OP["CALLDATALOAD"], OP["JUMP"]])
+    info = static_pass.analyze(bytes(c))
+    plane = static_pass.refined_plane(info, ["ArbitraryJump"])
+    jb = 1 << OP_BITS["JUMPI"]
+    assert int(info.reach_mask[0]) & jb
+    assert not int(plane[0]) & jb
+    assert int(plane[0]) & (1 << OP_BITS["JUMP"])
+
+
+def test_refined_plane_unknown_module_refuses():
+    info = static_pass.analyze(_converging_jumpi(push(1)))
+    assert static_pass.refined_plane(info, ["SomeUserModule"]) is None
+
+
+def test_refined_plane_off_switch():
+    info = static_pass.analyze(_converging_jumpi(push(1)))
+    static_pass.FORCE_TAINT = False
+    try:
+        assert static_pass.refined_plane(info, ["ArbitraryJump"]) is None
+    finally:
+        static_pass.FORCE_TAINT = True
+
+
+# -- randomized taint-soundness property -------------------------------------
+
+_SRC_LEAVES = (
+    ("CALLDATALOAD", lambda env: env["calldata"]),
+    ("CALLER", lambda env: env["caller"]),
+    ("ORIGIN", lambda env: env["origin"]),
+    ("CALLVALUE", lambda env: env["callvalue"]),
+    ("TIMESTAMP", lambda env: env["timestamp"]),
+    ("NUMBER", lambda env: env["number"]),
+    ("SLOAD", lambda env: env["storage"]),
+)
+
+_BINOPS = ("ADD", "MUL", "AND", "XOR", "OR", "SUB")
+
+
+def _gen_expr(rng, depth, force_clean):
+    """Random expression -> (code bytes, uses_source flag)."""
+    if depth <= 0 or rng.random() < 0.35:
+        if not force_clean and rng.random() < 0.5:
+            name, _ = _SRC_LEAVES[rng.randrange(len(_SRC_LEAVES))]
+            if name in ("CALLDATALOAD", "SLOAD"):
+                return push(rng.randrange(4)) + bytes([OP[name]]), True
+            return bytes([OP[name]]), True
+        return push(rng.randrange(1 << 16), 3), False
+    a, sa = _gen_expr(rng, depth - 1, force_clean)
+    b, sb = _gen_expr(rng, depth - 1, force_clean)
+    op = _BINOPS[rng.randrange(len(_BINOPS))]
+    return a + b + bytes([OP[op]]), sa or sb
+
+
+def _gen_program(rng, n_sites=5):
+    """Straight-line program: n converging JUMPI sites whose
+    conditions are random expressions (roughly half source-free).
+    Returns (code, {jumpi pc: uses_source})."""
+    c = bytearray()
+    truth = {}
+    for _ in range(n_sites):
+        expr, used = _gen_expr(rng, 3, force_clean=rng.random() < 0.5)
+        c += expr
+        j = len(c)
+        c += push(0, 2) + bytes([OP["JUMPI"]])
+        truth[j + 3] = used
+        d = len(c)
+        c[j + 1:j + 3] = d.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"]])
+    c += bytes([OP["STOP"]])
+    return bytes(c), truth
+
+
+def _run_concrete(code, env):
+    """Tiny concrete interpreter over the generator vocabulary;
+    returns {jumpi byte pc: condition value}."""
+    stack = []
+    conds = {}
+    pc = 0
+    while pc < len(code):
+        op = code[pc]
+        name = None
+        for n, d in OPCODES.items():
+            if d[ADDRESS] == op:
+                name = n
+                break
+        if 0x60 <= op <= 0x7F:
+            n_bytes = op - 0x5F
+            stack.append(int.from_bytes(code[pc + 1:pc + 1 + n_bytes],
+                                        "big"))
+            pc += 1 + n_bytes
+            continue
+        if name == "JUMPDEST":
+            pc += 1
+        elif name == "STOP":
+            break
+        elif name == "JUMPI":
+            dest, cond = stack.pop(), stack.pop()
+            conds[pc] = cond
+            pc += 1  # converging layout: dest == fallthrough
+        elif name == "ADD":
+            a, b = stack.pop(), stack.pop()
+            stack.append((a + b) & WORD)
+            pc += 1
+        elif name == "SUB":
+            a, b = stack.pop(), stack.pop()
+            stack.append((a - b) & WORD)
+            pc += 1
+        elif name == "MUL":
+            a, b = stack.pop(), stack.pop()
+            stack.append((a * b) & WORD)
+            pc += 1
+        elif name == "AND":
+            stack.append(stack.pop() & stack.pop())
+            pc += 1
+        elif name == "OR":
+            stack.append(stack.pop() | stack.pop())
+            pc += 1
+        elif name == "XOR":
+            stack.append(stack.pop() ^ stack.pop())
+            pc += 1
+        elif name == "CALLDATALOAD":
+            off = stack.pop()
+            stack.append((env["calldata"] * (off + 1)) & WORD)
+            pc += 1
+        elif name == "SLOAD":
+            slot = stack.pop()
+            stack.append((env["storage"] * (slot + 3)) & WORD)
+            pc += 1
+        elif name in ("CALLER", "ORIGIN", "CALLVALUE", "TIMESTAMP",
+                      "NUMBER"):
+            key = {"CALLER": "caller", "ORIGIN": "origin",
+                   "CALLVALUE": "callvalue", "TIMESTAMP": "timestamp",
+                   "NUMBER": "number"}[name]
+            stack.append(env[key] & WORD)
+            pc += 1
+        else:
+            raise AssertionError(f"unexpected op {name}")
+    return conds
+
+
+@pytest.mark.parametrize("seed", [7, 42, 365, 2024])
+def test_randomized_taint_soundness(seed):
+    """Every condition the analysis marks untainted must be
+    INDEPENDENT of all sources: two concrete runs pinning every
+    source to different values yield the same value at the site.
+    Source-free conditions must also be marked clean (precision on
+    this vocabulary)."""
+    rng = random.Random(seed)
+    for _ in range(10):
+        code, truth = _gen_program(rng)
+        info = static_pass.analyze(code)
+        env_a = {"calldata": 0x1111, "caller": 0x2222, "origin": 0x3333,
+                 "callvalue": 0x44, "timestamp": 0x55, "number": 0x66,
+                 "storage": 0x77}
+        env_b = {"calldata": 0xA1A1, "caller": 0xB2B2, "origin": 0xC3C3,
+                 "callvalue": 0xD4, "timestamp": 0xE5, "number": 0xF6,
+                 "storage": 0x9797}
+        conds_a = _run_concrete(code, env_a)
+        conds_b = _run_concrete(code, env_b)
+        for pc, uses_source in truth.items():
+            st = info.site_taints[pc]
+            if st.cond == taint_mod.CLEAN:
+                # the soundness contract itself
+                assert conds_a[pc] == conds_b[pc], (
+                    f"seed {seed} pc {pc}: untainted cond changed "
+                    f"{conds_a[pc]:#x} -> {conds_b[pc]:#x}")
+            if not uses_source:
+                assert st.cond == taint_mod.CLEAN, (
+                    f"seed {seed} pc {pc}: source-free cond "
+                    f"over-tainted ({st.cond})")
+
+
+# -- selector recovery -------------------------------------------------------
+
+
+def _dispatcher(form, sels_targets):
+    """Hand-assembled dispatcher; returns (code, expected map)."""
+    c = bytearray()
+    c += push(0) + bytes([OP["CALLDATALOAD"]])
+    if form == "shr":
+        c += push(224) + bytes([OP["SHR"]])
+    else:  # div
+        c += push(1 << 224, 29) + bytes([OP["SWAP1"], OP["DIV"]])
+    patches = []
+    for sel, _ in sels_targets:
+        c += bytes([OP["DUP1"]]) + push(sel, 4) + bytes([OP["EQ"]])
+        patches.append(len(c))
+        c += push(0, 2) + bytes([OP["JUMPI"]])
+    c += bytes([OP["STOP"]])
+    expected = {}
+    for (sel, body), patch in zip(sels_targets, patches):
+        t = len(c)
+        c[patch + 1:patch + 3] = t.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"]]) + body + bytes([OP["STOP"]])
+        expected[sel] = t
+    return bytes(c), expected
+
+
+class TestSelectorRecovery:
+    def test_shr_form(self):
+        code, expected = _dispatcher("shr", [
+            (0x11111111, push(1) + bytes([OP["POP"]])),
+            (0x22222222, b""),
+        ])
+        info = static_pass.analyze(code)
+        assert info.selector_map == expected
+
+    def test_div_swap_form(self):
+        code, expected = _dispatcher("div", [
+            (0xCAFEBABE, b""),
+            (0xDEADBEEF, b""),
+        ])
+        info = static_pass.analyze(code)
+        assert info.selector_map == expected
+
+    def test_binary_search_split(self):
+        # GT split over two sub-chains (the solidity >4-function shape)
+        c = bytearray()
+        c += push(0) + bytes([OP["CALLDATALOAD"]])
+        c += push(224) + bytes([OP["SHR"]])
+        # if sel > 0x80000000 goto hi-chain
+        c += bytes([OP["DUP1"]]) + push(0x80000000, 4) + bytes([OP["GT"]])
+        split = len(c)
+        c += push(0, 2) + bytes([OP["JUMPI"]])
+        # lo chain
+        c += bytes([OP["DUP1"]]) + push(0x10101010, 4) + bytes([OP["EQ"]])
+        plo = len(c)
+        c += push(0, 2) + bytes([OP["JUMPI"], OP["STOP"]])
+        hi = len(c)
+        c[split + 1:split + 3] = hi.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"], OP["DUP1"]])
+        c += push(0x90909090, 4) + bytes([OP["EQ"]])
+        phi = len(c)
+        c += push(0, 2) + bytes([OP["JUMPI"], OP["STOP"]])
+        tlo = len(c)
+        c[plo + 1:plo + 3] = tlo.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"], OP["STOP"]])
+        thi = len(c)
+        c[phi + 1:phi + 3] = thi.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"], OP["STOP"]])
+        info = static_pass.analyze(bytes(c))
+        assert info.selector_map == {0x10101010: tlo, 0x90909090: thi}
+
+    def test_no_dispatcher_is_empty(self):
+        info = static_pass.analyze(
+            bytes([*push(1), *push(2), OP["ADD"], OP["POP"], OP["STOP"]]))
+        assert info.selector_map == {}
+
+
+# -- the independence relation / tx-prune rules ------------------------------
+
+
+def _fd(entry=0, reads=frozenset(), writes=frozenset(),
+        effects=False, balance=False):
+    return FunctionDeps(entry, reads, writes, effects, balance)
+
+
+class TestPrunable:
+    def test_final_round_one_sided(self):
+        f = _fd(writes=frozenset({1}))
+        g = _fd(reads=frozenset({2}))
+        assert deps_mod.prunable(f, g, final_round=True)
+
+    def test_overlap_blocks(self):
+        f = _fd(writes=frozenset({1}))
+        g = _fd(reads=frozenset({1, 2}))
+        assert not deps_mod.prunable(f, g, final_round=True)
+
+    def test_incomplete_blocks(self):
+        assert not deps_mod.prunable(
+            _fd(writes=None), _fd(reads=frozenset({2})), True)
+        assert not deps_mod.prunable(
+            _fd(writes=frozenset({1})), _fd(reads=None), True)
+
+    def test_effects_block(self):
+        f = _fd(writes=frozenset({1}), effects=True)
+        g = _fd(reads=frozenset({2}))
+        assert not deps_mod.prunable(f, g, True)
+
+    def test_balance_observer_blocks(self):
+        f = _fd(writes=frozenset({1}))
+        g = _fd(reads=frozenset({2}), balance=True)
+        assert not deps_mod.prunable(f, g, True)
+
+    def test_non_final_needs_commutation(self):
+        f = _fd(reads=frozenset({3}), writes=frozenset({1}))
+        g = _fd(reads=frozenset({2}), writes=frozenset({1}))
+        # write/write overlap: not commuting
+        assert not deps_mod.prunable(f, g, final_round=False)
+        g2 = _fd(reads=frozenset({2}), writes=frozenset({4}))
+        assert deps_mod.prunable(f, g2, final_round=False)
+
+    def test_excluded_selectors_canonical_order(self):
+        class Info:
+            selector_map = {0x0A: 10, 0x0B: 20}
+            func_deps = {
+                10: _fd(10, reads=frozenset({1}), writes=frozenset({2})),
+                20: _fd(20, reads=frozenset({3}), writes=frozenset({4})),
+            }
+
+        # commuting pair: only the non-canonical ordering prunes
+        assert deps_mod.excluded_selectors(Info, 10, False) == [0x0B]
+        assert deps_mod.excluded_selectors(Info, 20, False) == []
+        # final round prunes both directions
+        assert deps_mod.excluded_selectors(Info, 20, True) == [0x0A, 0x0B]
+
+    def test_unknown_prev_entry_excludes_nothing(self):
+        class Info:
+            selector_map = {0x0A: 10}
+            func_deps = {10: _fd(10)}
+
+        assert deps_mod.excluded_selectors(Info, None, True) == []
+        assert deps_mod.excluded_selectors(Info, 99, True) == []
+
+
+# -- static fact seeding -----------------------------------------------------
+
+
+def _ite_tree():
+    from mythril_tpu.smt import terms as T
+
+    v = T.bv_var("taint_test_slot", 256)
+    return T.mk_ite(T.mk_eq(v, T.bv_const(1, 256)),
+                    T.bv_const(7, 256), T.bv_const(0, 256))
+
+
+class _PinnableInfo:
+    code_hash = "t" * 64
+    writes_complete = True
+
+
+class TestStaticFacts:
+    def test_candidate_leaves(self):
+        t = _ite_tree()
+        assert deps_mod.candidate_facts(t) == (0, 7)
+
+    def test_non_const_leaf_is_none(self):
+        from mythril_tpu.smt import terms as T
+
+        v = T.bv_var("taint_test_v", 256)
+        t = T.mk_ite(T.mk_eq(v, T.bv_const(1, 256)), v,
+                     T.bv_const(0, 256))
+        assert deps_mod.candidate_facts(t) is None
+
+    def test_eq_refuted_inside_hull(self):
+        from mythril_tpu.smt import terms as T
+
+        deps_mod.register_code(_PinnableInfo())
+        t = _ite_tree()
+        # 3 lies INSIDE [0, 7] but outside the leaf set {0, 7}
+        assert deps_mod.static_eq_refuted(
+            [T.mk_eq(t, T.bv_const(3, 256))])
+        assert not deps_mod.static_eq_refuted(
+            [T.mk_eq(t, T.bv_const(7, 256))])
+
+    def test_hints_minted_and_gated(self):
+        from mythril_tpu.smt import terms as T
+
+        t = _ite_tree()
+        probe = [T.mk_ule(t, T.bv_const(100, 256))]
+        assert deps_mod.static_hints_for_set(probe) == []  # gate shut
+        deps_mod.register_code(_PinnableInfo())
+        hints = deps_mod.static_hints_for_set(probe)
+        assert len(hints) == 1 and hints[0].op == "or"
+        static_pass.FORCE_TAINT = False
+        try:
+            assert deps_mod.static_hints_for_set(probe) == []
+        finally:
+            static_pass.FORCE_TAINT = True
+
+    def test_hint_is_implied(self):
+        """The minted disjunction must be IMPLIED by the term alone:
+        under EVERY assignment of the ITE condition variable the hint
+        evaluates true (checked by a tiny structural evaluator over
+        the fact's op vocabulary)."""
+        from mythril_tpu.smt import terms as T
+
+        def ev(term, slot):
+            if term.op == T.BV_CONST:
+                return term.val
+            if term.op == T.BV_VAR:
+                return slot
+            if term.op == T.EQ:
+                return ev(term.args[0], slot) == ev(term.args[1], slot)
+            if term.op == T.ITE:
+                return ev(term.args[1], slot) if ev(term.args[0], slot) \
+                    else ev(term.args[2], slot)
+            if term.op == T.OR:
+                return any(ev(a, slot) for a in term.args)
+            raise AssertionError(term.op)
+
+        deps_mod.register_code(_PinnableInfo())
+        t = _ite_tree()
+        (hint,) = deps_mod.static_hints_for_set([T.mk_eq(
+            t, T.bv_const(0, 256))])
+        for pinned in (0, 1, 7, 99):
+            assert ev(hint, pinned) is True
+
+
+# -- memo LRU regression (PR 8 satellite) ------------------------------------
+
+
+class _Entry:
+    def __init__(self, key):
+        self.code_hash = key
+
+
+class TestMemoLRU:
+    def setup_method(self):
+        static_memo.clear()
+
+    def teardown_method(self):
+        static_memo.clear()
+
+    def test_import_never_evicts_hot_entries(self):
+        cap = static_memo._MEMO_CAP
+        hot = [f"hot{i}" for i in range(cap)]
+        for k in hot:
+            static_memo.put(k, _Entry(k))
+        before = static_memo.evictions()
+        imported = static_memo.import_entries(
+            [_Entry(f"imp{i}") for i in range(cap)])
+        assert imported == 0  # memo full: imports dropped, not evicted
+        assert static_memo.evictions() == before
+        for k in hot:
+            assert static_memo.get(k) is not None
+
+    def test_import_fills_cold_slots(self):
+        static_memo.put("hot", _Entry("hot"))
+        n = static_memo.import_entries([_Entry("a"), _Entry("b")])
+        assert n == 2
+        assert static_memo.get("a") is not None
+        # imports land cold: filling to the cap evicts THEM first
+        cap = static_memo._MEMO_CAP
+        for i in range(cap - 3):
+            static_memo.put(f"k{i}", _Entry(f"k{i}"))
+        static_memo.get("hot")  # bump
+        static_memo.put("overflow", _Entry("overflow"))
+        assert static_memo.get("hot") is not None
+        # the LRU victim is a cold import, not any resident entry
+        assert static_memo.get("b") is None
+
+    def test_get_bumps_recency(self):
+        cap = static_memo._MEMO_CAP
+        for i in range(cap):
+            static_memo.put(f"k{i}", _Entry(f"k{i}"))
+        static_memo.get("k0")  # k0 becomes most-recent
+        static_memo.put("new", _Entry("new"))
+        assert static_memo.get("k0") is not None
+        assert static_memo.get("k1") is None  # true LRU left instead
+
+    def test_eviction_counter(self):
+        cap = static_memo._MEMO_CAP
+        before = static_memo.evictions()
+        for i in range(cap + 5):
+            static_memo.put(f"e{i}", _Entry(f"e{i}"))
+        assert static_memo.evictions() == before + 5

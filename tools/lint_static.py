@@ -20,6 +20,19 @@ session registry; an ad-hoc lock around terms either double-locks
 (ordering hazards with the pool workers) or protects nothing. New
 sites must go through the helpers — or be explicitly allowlisted.
 
+Rule 3 — broad-except-swallows-fatal (the PR-5 ``_device_failed``
+class): a broad handler (``except Exception``, ``except
+BaseException``, or bare ``except``) in ``mythril_tpu/ops/`` or
+``mythril_tpu/smt/solver/`` that neither re-raises anywhere in its
+body nor sits behind an earlier ``except (KeyboardInterrupt,
+MemoryError): raise`` handler in the same try. Those layers sit under
+every retry/backoff loop in the system: a swallowed MemoryError (or a
+KeyboardInterrupt under a bare except) converts a fatal condition
+into a silent screen-degrade and the run grinds on wrong-speed
+instead of dying loudly — exactly the bug PR 5 fixed in
+models/pruner._device_failed. Deliberate telemetry/fallback sites are
+allowlisted with reasons.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -82,6 +95,61 @@ def _is_jax_backend_call(node: ast.Call) -> bool:
     return "jax" in parts
 
 
+_BROAD_EXC = frozenset(("Exception", "BaseException"))
+_FATAL_EXC = frozenset(("KeyboardInterrupt", "MemoryError"))
+#: rule-3 scope: the layers every retry/backoff loop funnels through
+_RULE3_ROOTS = ("mythril_tpu/ops/", "mythril_tpu/smt/solver/")
+
+
+def _exc_names(node) -> set:
+    """Exception class names a handler's type expression mentions."""
+    if node is None:
+        return set()
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _fatal_guarded(tryn: ast.Try, broad: ast.ExceptHandler) -> bool:
+    """An EARLIER handler in the same try re-raises the fatal classes,
+    so the broad handler can never see them."""
+    for h in tryn.handlers:
+        if h is broad:
+            return False
+        if _exc_names(h.type) & _FATAL_EXC and _reraises(h):
+            return True
+    return False
+
+
+def _broad_except_findings(rel: str, tree) -> List["Finding"]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            names = _exc_names(h.type)
+            broad = h.type is None or (names & _BROAD_EXC)
+            if not broad:
+                continue
+            if _reraises(h) or _fatal_guarded(node, h):
+                continue
+            out.append(Finding(
+                rel, h.lineno, "broad-except-swallows-fatal",
+                "broad except swallows KeyboardInterrupt/MemoryError "
+                "without re-raising (guard with an earlier "
+                "`except (KeyboardInterrupt, MemoryError): raise` or "
+                "allowlist with a reason)"))
+    return out
+
+
 def _is_lock_create(node: ast.Call) -> bool:
     fn = node.func
     if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_NAMES:
@@ -138,6 +206,9 @@ def lint_file(path: Path) -> List[Finding]:
                     "threading lock created in the smt layer outside "
                     "the sanctioned session/interning helpers "
                     "(allowlist deliberate sites)"))
+
+    if any(rel.startswith(root) for root in _RULE3_ROOTS):
+        out.extend(_broad_except_findings(rel, tree))
     return out
 
 
