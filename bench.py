@@ -1655,6 +1655,149 @@ def _smoke_taint():
     return result
 
 
+def _smoke_trace():
+    """Stage 10: the observability gate (docs/observability.md).
+
+    A rigged diamond-storm analysis (build_diamond_contract through
+    the REAL lane drain + svm rounds) runs twice — untraced, then
+    traced (MTPU_TRACE equivalent via trace.set_enabled) — gating:
+
+    * spans recorded across >= 4 subsystems (name prefixes: lane,
+      solver, svm, merge, intervals, propagate, static, xla, ...);
+    * a valid Chrome trace-event export (traceEvents list, complete
+      X events with ts/dur, thread_name metadata) plus a parseable
+      JSONL twin;
+    * the crash flight recorder fires on an induced fatal in a
+      subprocess (crash/metrics/trace/inflight artifacts present);
+    * traced-vs-untraced wall within 5% (plus a 0.5 s absolute floor
+      for timer noise on tiny CI runs) and ISSUE IDENTITY — tracing
+      must observe the run, never change it."""
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.support.analysis_args import make_cmd_args
+    from mythril_tpu.support.telemetry import trace
+
+    code = build_diamond_contract(k=6, dup_levels=2)
+
+    def analyze(tpu_lanes, tx_count):
+        reset_analysis_state()
+        dis = MythrilDisassembler(eth=None)
+        address, _ = dis.load_from_bytecode(code.hex(),
+                                            bin_runtime=True)
+        analyzer = MythrilAnalyzer(
+            disassembler=dis,
+            cmd_args=make_cmd_args(execution_timeout=120,
+                                   tpu_lanes=tpu_lanes),
+            strategy="bfs", address=address)
+        t0 = time.perf_counter()
+        report = analyzer.fire_lasers(modules=None,
+                                      transaction_count=tx_count)
+        wall = time.perf_counter() - t0
+        return wall, sorted((i.swc_id, i.address, i.title)
+                            for i in report.issues.values())
+
+    lane_engine.PATH_HISTORY[code] = 64
+    lane_engine.FORCE_WIDTH = 64
+    old_window = lane_engine.DEFAULT_WINDOW
+    lane_engine.DEFAULT_WINDOW = 32
+    was_on = trace.enabled()
+    try:
+        lane_engine.warm_variant(
+            64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        analyze(64, 2)  # warm-up: jit variants + solver session
+        trace.set_enabled(False)
+        wall_off, issues_off = analyze(64, 2)
+        trace.clear()
+        trace.set_enabled(True)
+        wall_on, issues_on = analyze(64, 2)
+    finally:
+        trace.set_enabled(was_on)
+        lane_engine.FORCE_WIDTH = None
+        lane_engine.DEFAULT_WINDOW = old_window
+
+    events = trace.snapshot_events()
+    subsystems = sorted({name.split(".", 1)[0]
+                         for (_ph, name, _t0, _dur, _tid, _attrs)
+                         in events})
+    tmp = Path(tempfile.mkdtemp(prefix="mtpu_trace_smoke_"))
+    trace_path = tmp / "trace.json"
+    trace.export_chrome_trace(trace_path)
+    trace.export_jsonl(tmp / "trace.jsonl")
+    export_ok = False
+    try:
+        payload = json.loads(trace_path.read_text())
+        te = payload.get("traceEvents", [])
+        export_ok = (
+            isinstance(te, list) and len(te) > 0
+            and all("name" in e and "ph" in e and "pid" in e
+                    and "tid" in e for e in te)
+            and all("ts" in e for e in te if e["ph"] != "M")
+            and any(e["ph"] == "M"
+                    and e.get("name") == "thread_name" for e in te)
+            and any(e["ph"] == "X" and "dur" in e for e in te)
+            and all(json.loads(line) is not None for line in
+                    (tmp / "trace.jsonl").read_text().splitlines()))
+    except Exception:
+        export_ok = False
+
+    # flight recorder: induced fatal in a clean subprocess (telemetry
+    # only — no jax import, so this is fast)
+    rec_dir = tmp / "rec"
+    prog = (
+        "import sys; sys.path.insert(0, {root!r})\n"
+        "from mythril_tpu.support import telemetry\n"
+        "telemetry.configure(out_dir={out!r}, enable=True)\n"
+        "with telemetry.trace.span('smoke.fatal_span', n=1): pass\n"
+        "raise RuntimeError('induced fatal for the flight recorder')\n"
+    ).format(root=str(Path(__file__).resolve().parent),
+             out=str(rec_dir))
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          capture_output=True, text=True, timeout=120)
+    fr = rec_dir / "flightrec"
+    rec_ok = bool(
+        proc.returncode != 0
+        and (fr / "crash_rank0.json").exists()
+        and (fr / "metrics_rank0.json").exists()
+        and (fr / "trace_rank0.json").exists()
+        and (fr / "inflight_rank0.json").exists()
+        and "induced fatal" in (fr / "crash_rank0.json").read_text())
+
+    # wall gate: 5% plus an absolute floor — this box's timer noise on
+    # a ~seconds-long run otherwise dominates (single-CPU container
+    # constraint: the hard gates above are structural, not wall)
+    wall_ok = wall_on <= wall_off * 1.05 + 0.5
+    result = {
+        "subsystems": subsystems,
+        "spans": len(events),
+        "export_valid": export_ok,
+        "flight_recorder": rec_ok,
+        "wall_s": {"untraced": round(wall_off, 3),
+                   "traced": round(wall_on, 3)},
+        "wall_within_5pct": wall_ok,
+        "issues_identical": issues_on == issues_off,
+        "issues": len(issues_on),
+    }
+    result["ok"] = bool(
+        len(events) > 0
+        and len(subsystems) >= 4
+        and export_ok
+        and rec_ok
+        and wall_ok
+        and result["issues_identical"]
+        and len(issues_on) > 0)
+    return result
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
@@ -1716,7 +1859,13 @@ def bench_smoke():
        independent tx-pair orderings excluded), static-fact seeding
        with nonzero hinted_solves, and issue identity with
        MTPU_TAINT on vs off on both the lane and host paths. Any
-       miss exits 1.
+       miss exits 1;
+    10. the observability gate (_smoke_trace,
+       docs/observability.md): a traced rigged run gating spans
+       recorded across >= 4 subsystems, a valid Chrome trace-event
+       export (+ JSONL twin), the crash flight recorder firing on an
+       induced fatal in a subprocess, and traced-vs-untraced wall
+       within 5% with issue identity. Any miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -1903,6 +2052,20 @@ def bench_smoke():
     else:
         out["taint"] = {"skipped": True, "ok": True}
 
+    # stage 10: the observability gate (docs/observability.md):
+    # traced rigged run with spans across >= 4 subsystems, valid
+    # Chrome-trace export, flight-recorder dump on an induced fatal,
+    # traced-vs-untraced wall within 5% and issue identity;
+    # skippable for the quick inner loop via MTPU_SMOKE_TRACE=0
+    if os.environ.get("MTPU_SMOKE_TRACE", "1") != "0":
+        try:
+            out["trace"] = _smoke_trace()
+        except Exception as e:
+            out["trace"] = {"ok": False, "error": type(e).__name__,
+                            "detail": str(e)[:200]}
+    else:
+        out["trace"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -1936,7 +2099,11 @@ def bench_smoke():
           and out["static"].get("ok", False)
           # the taint gate: refined-plane drops, tx-sequence prunes,
           # static fact seeding, issue identity vs MTPU_TAINT=0
-          and out["taint"].get("ok", False))
+          and out["taint"].get("ok", False)
+          # the observability gate: multi-subsystem spans, valid
+          # Chrome trace, flight recorder on induced fatal, off-path
+          # wall parity with issue identity
+          and out["trace"].get("ok", False))
     return 0 if ok else 1
 
 
@@ -2023,7 +2190,22 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--trace-out" in sys.argv[1:]:
+        # span tracing + Chrome trace export for the whole bench run
+        # (docs/observability.md). Flushed explicitly below: os._exit
+        # skips atexit hooks.
+        from mythril_tpu.support import telemetry as _telemetry
+
+        _telemetry.configure(
+            trace_out=sys.argv[sys.argv.index("--trace-out") + 1],
+            enable=True)
     rc = bench_smoke() if "--smoke" in sys.argv[1:] else main()
+    try:
+        from mythril_tpu.support import telemetry as _telemetry
+
+        _telemetry.flush_trace()
+    except Exception:
+        pass
     # hard exit: the tunneled axon client can throw from a background
     # thread during interpreter teardown ("terminate called ...",
     # SIGABRT) AFTER all results are printed — skip destructors so the

@@ -73,11 +73,21 @@ def main():
         help="persistent solver pool width (smt/solver/pool.py; "
         "default $MTPU_SOLVER_WORKERS or min(4, cpu); 1 = serial)",
     )
+    parser.add_argument(
+        "--trace-out", default=None, metavar="FILE",
+        help="record telemetry spans (implies MTPU_TRACE=1) and "
+        "write a Chrome trace-event JSON to FILE at exit "
+        "(docs/observability.md)",
+    )
     cli = parser.parse_args()
     if cli.solver_workers is not None:
         from mythril_tpu.smt.solver.pool import configure_pool
 
         configure_pool(workers=cli.solver_workers)
+    if cli.trace_out:
+        from mythril_tpu.support import telemetry
+
+        telemetry.configure(trace_out=cli.trace_out, enable=True)
     timeout = cli.timeout
     fixtures = sorted(INPUTS.glob("*.sol.o"))
     if not fixtures:

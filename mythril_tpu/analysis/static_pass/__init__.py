@@ -224,7 +224,10 @@ def info_for(code: bytes) -> Optional[StaticInfo]:
     info = memo.get(key)
     if info is None:
         try:
-            info = analyze(code)
+            from ...support.telemetry import trace
+
+            with trace.span("static.analyze", code_len=len(code)):
+                info = analyze(code)
         except Exception as e:  # a screen, never an error path
             log.warning("static pass failed (%s); consumers fall back",
                         e)

@@ -275,6 +275,13 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
                         help="Checkpoint the analysis after each "
                              "symbolic transaction round; if FILE "
                              "already holds a snapshot, resume from it")
+    options.add_argument("--trace-out", metavar="FILE", default=None,
+                        help="Record structured telemetry spans "
+                             "(implies MTPU_TRACE=1) and write a "
+                             "Chrome trace-event JSON to FILE at exit "
+                             "(load in Perfetto; a FILE+'l' JSONL "
+                             "twin rides along — "
+                             "docs/observability.md)")
 
 
 def create_analyzer_parser(parser: argparse.ArgumentParser) -> None:

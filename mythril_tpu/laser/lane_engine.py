@@ -49,6 +49,7 @@ from ..smt import terms as T
 from . import alu
 from .state.global_state import GlobalState
 from .state.calldata import ConcreteCalldata
+from ..support.telemetry import trace
 
 log = logging.getLogger(__name__)
 
@@ -1131,7 +1132,8 @@ def _compiled_code(code_bytes: bytes, fentries) -> "CompiledCode":
             info = static_pass.info_for(code_bytes)
             if info is not None:
                 det_mask = info.reach_mask
-        with _prof("compile_code"):
+        with _prof("compile_code"), trace.span(
+                "xla.compile_code", code_len=len(code_bytes)):
             cc = compile_code(code_bytes, func_entries=key[1],
                               det_mask=det_mask)
         if len(_CC_CACHE) >= 64:  # bound device-resident code tensors
@@ -1178,6 +1180,18 @@ def _warm_one(n_lanes: int, code_len: int, lane_kwargs: dict,
     from ..support.devices import device_exec_ok
 
     device_exec_ok()  # pull the once-per-process probe into warm-up
+
+    with trace.span("xla.compile_variant", n_lanes=n_lanes,
+                    code_len=code_len, window=window,
+                    seed_bucket=seed_bucket):
+        _warm_one_inner(n_lanes, code_len, lane_kwargs, window,
+                        step_budget, seed_bucket)
+
+
+def _warm_one_inner(n_lanes: int, code_len: int, lane_kwargs: dict,
+                    window: int, step_budget: int,
+                    seed_bucket: int = 16) -> None:
+    from ..ops.stepper import _code_bucket
 
     eng = LaneEngine(n_lanes=n_lanes, window=window,
                      step_budget=step_budget, **lane_kwargs)
@@ -2486,10 +2500,11 @@ class LaneEngine:
 
         t0 = time.perf_counter()
         try:
-            verdicts = solver_batch.discharge(
-                term_sets, timeout_s=2.0, conflict_budget=16384,
-                quick_sat=quick_sat, on_sat_model=on_sat_model,
-                registry=registry)
+            with trace.span("lane.fork_screen", n=len(queries)):
+                verdicts = solver_batch.discharge(
+                    term_sets, timeout_s=2.0, conflict_budget=16384,
+                    quick_sat=quick_sat, on_sat_model=on_sat_model,
+                    registry=registry)
         except Exception as e:  # a screen, never an error path
             log.debug("fork-feasibility screen failed: %s", e)
             return []
@@ -2638,6 +2653,7 @@ class LaneEngine:
             from ..smt.solver.solver_statistics import SolverStatistics
 
             SolverStatistics().bump(static_retired_lanes=retired)
+            trace.event("static.retire", retired=retired)
             log.info("static pass retired %d lanes at the window "
                      "boundary", retired)
 
@@ -2735,7 +2751,9 @@ class LaneEngine:
             prov_pairs[j, 0] = lane * d_recs + slot
             prov_pairs[j, 1] = oid
         try:
-            with _prof("merge_fp"):
+            with _prof("merge_fp"), \
+                    trace.span("merge.fingerprint",
+                               groups=len(pre)):
                 fp = np.asarray(jax.device_get(_merge_fingerprint(
                     st, jnp.asarray(prov_pairs))))
         except Exception as e:  # a screen, never an error path
@@ -2788,6 +2806,8 @@ class LaneEngine:
                 lanes_merged=merged, lanes_subsumed=subsumed,
                 merge_rounds=1)
             merge_mod.note_retired(merged + subsumed)
+            trace.event("merge.window", merged=merged,
+                        subsumed=subsumed)
             log.info("lane merge: %d merged, %d subsumed at window "
                      "boundary", merged, subsumed)
 
@@ -2877,14 +2897,17 @@ class LaneEngine:
                 return
             t0 = time.perf_counter()
             n_mat = 0
-            for rows_ref, floors, items in pending_mat:
-                if floors is not None:  # deferred device rows
-                    with _prof("retire_pull"):
-                        rows_ref = _unpack_rows(
-                            jax.device_get(rows_ref), *floors)
-                for row, ctx in items:
-                    results.append(self.materialize(rows_ref, row, ctx))
-                    n_mat += 1
+            with trace.span("lane.materialize",
+                            waves=len(pending_mat)):
+                for rows_ref, floors, items in pending_mat:
+                    if floors is not None:  # deferred device rows
+                        with _prof("retire_pull"):
+                            rows_ref = _unpack_rows(
+                                jax.device_get(rows_ref), *floors)
+                    for row, ctx in items:
+                        results.append(
+                            self.materialize(rows_ref, row, ctx))
+                        n_mat += 1
             self.stats["overlap_mat"] += n_mat
             self.stats["overlap_mat_ms"] += int(
                 (time.perf_counter() - t0) * 1000)
@@ -2908,6 +2931,9 @@ class LaneEngine:
         screen_future = None
         screen_dead: List[int] = []
         t_idle0 = None
+        trace.begin("lane.explore", n_lanes=self.n_lanes,
+                    entries=len(entry_states),
+                    code_len=len(code_bytes))
         try:
             while True:
                 # a seed backlog beyond the small bucket drains in ONE
@@ -2947,7 +2973,9 @@ class LaneEngine:
                     self.stats["overlap_idle_ms"] += int(idle_ms)
                     _solver_stats.overlap_idle_ms += idle_ms
                     t_idle0 = None
-                with _prof("window_exec", sync=lambda: st.pc):
+                with _prof("window_exec", sync=lambda: st.pc), \
+                        trace.span("lane.window_dispatch",
+                                   seeds=k, window=self.window):
                     st, visited, out = _window_exec(
                         st, cc, i32buf, u8buf, self.exec_table,
                         self.taint_table, self.window, k,
@@ -2991,7 +3019,8 @@ class LaneEngine:
                          len(code_bytes), self.n_lanes))
                 self.stats["windows"] += 1
                 t_wait0 = time.perf_counter()
-                with _prof("window_pull"):
+                with _prof("window_pull"), \
+                        trace.span("lane.window_pull"):
                     (misc, scal, utab, ftab, ridx, r_i32, r_u32,
                      r_u8, hidx, h_i32, h_u32, h_u8) = [
                         np.asarray(x) for x in jax.device_get(out)]
@@ -3316,6 +3345,9 @@ class LaneEngine:
             # the last window has no successor dispatch to hide behind
             _flush_pending()
         finally:
+            trace.end("lane.explore",
+                      windows=self.stats["windows"]
+                      - stats0.get("windows", 0))
             # an exception mid-sweep (svm falls back to the host)
             # must not lose coverage accumulated in prior windows;
             # a donated-then-failed dispatch can leave the bitmap

@@ -488,7 +488,16 @@ def merge_open_states(open_states: List) -> List:
     if not enabled() or len(open_states) < 2:
         return open_states
     from ..smt.solver.solver_statistics import SolverStatistics
+    from ..support.telemetry import trace
     from .state.constraints import Constraints
+
+    with trace.span("merge.open_states", n=len(open_states)):
+        return _merge_open_states_inner(open_states,
+                                        SolverStatistics, Constraints)
+
+
+def _merge_open_states_inner(open_states, SolverStatistics,
+                             Constraints):
 
     groups: Dict[tuple, List[int]] = {}
     for i, ws in enumerate(open_states):

@@ -75,117 +75,20 @@ class BenchmarkPlugin(LaserPlugin):
             self.nr_of_executed_insns,
             self.nr_of_executed_insns / duration if duration else 0.0,
         )
-        # batched-discharge + drain-pipeline counters
-        # (docs/drain_pipeline.md): process-cumulative, so the sweep's
-        # own contribution is the delta since the run began — still the
-        # right visibility signal for "did the batch layer engage"
+        # solver counter block: this plugin is a thin renderer over
+        # the telemetry registry — the group lines (and which counter
+        # lands in which line) live in support/telemetry/render.py,
+        # shared with the instruction profiler and guarded by the
+        # counter-drift test (tests/test_counter_drift.py)
         try:
             from ....smt.solver.solver_statistics import (
                 SolverStatistics,
             )
+            from ....support.telemetry import render
 
             counters = SolverStatistics().batch_counters()
             log.info("Solver batch/pipeline: %s", counters)
-            # run-wide verdict cache (docs/feasibility_cache.md): the
-            # three reuse tiers, one line — exact hits, ancestor-UNSAT
-            # kills, parent-model shadows — plus the combined
-            # queries_saved figure bench.py gates on
-            log.info(
-                "Verdict cache: hits=%d unsat_kills=%d shadows=%d "
-                "shadow_rejects=%d bound_seeds=%d queries_saved=%d",
-                counters["verdict_hits"],
-                counters["verdict_unsat_kills"],
-                counters["verdict_shadows"],
-                counters["verdict_shadow_rejects"],
-                counters["verdict_bound_seeds"],
-                counters["queries_saved"],
-            )
-            # bidirectional propagation screen (docs/propagation.md):
-            # product-domain lane kills, fixpoint sweeps, harvested
-            # facts and the solves they hinted
-            if counters["propagate_kills"] or \
-                    counters["facts_harvested"] or \
-                    counters["hinted_solves"]:
-                log.info(
-                    "Propagation: kills=%d sweeps=%d facts=%d "
-                    "hinted_solves=%d",
-                    counters["propagate_kills"],
-                    counters["propagate_sweeps"],
-                    counters["facts_harvested"],
-                    counters["hinted_solves"],
-                )
-            # window/round-boundary lane merge (docs/lane_merge.md):
-            # exact-frontier twins collapsed under OR'd suffixes,
-            # siblings retired by subsumption, and the passes/OR terms
-            # that did it
-            if counters["lanes_merged"] or \
-                    counters["lanes_subsumed"]:
-                log.info(
-                    "Lane merge: merged=%d subsumed=%d rounds=%d "
-                    "or_terms=%d",
-                    counters["lanes_merged"],
-                    counters["lanes_subsumed"],
-                    counters["merge_rounds"],
-                    counters["or_terms_built"],
-                )
-            # persistent solver pool (docs/solver_pool.md): worker
-            # count, pooled queries, portfolio races (and which tactic
-            # won them), affinity hits, deaths, and the solver wall
-            # hidden behind device/host work by the async futures
-            if counters["pool_workers"] > 1 or \
-                    counters["queries_pooled"]:
-                log.info(
-                    "Solver pool: workers=%d pooled=%d races=%d "
-                    "race_wins=%s affinity_hits=%d deaths=%d "
-                    "async_overlap_ms=%s",
-                    counters["pool_workers"],
-                    counters["queries_pooled"],
-                    counters["portfolio_races"],
-                    counters["races_won_by_tactic"],
-                    counters["affinity_prefix_hits"],
-                    counters["worker_deaths"],
-                    counters["async_overlap_ms"],
-                )
-            # static bytecode pre-analysis (docs/static_pass.md):
-            # blocks recovered, jump sites resolved, lanes/states
-            # retired with zero solver work, pruner probes answered
-            # by set-disjointness
-            if counters["static_blocks"] or \
-                    counters["static_retired_lanes"] or \
-                    counters["static_pruner_skips"]:
-                log.info(
-                    "Static pass: blocks=%d jumps_resolved=%d "
-                    "retired=%d pruner_skips=%d",
-                    counters["static_blocks"],
-                    counters["static_jumps_resolved"],
-                    counters["static_retired_lanes"],
-                    counters["static_pruner_skips"],
-                )
-            # taint/dependence dataflow layer (docs/static_pass.md):
-            # refined-plane anchor drops, tx-pair orderings excluded
-            # by the static independence screen, implied facts seeded
-            # ahead of solves, and memo-cap evictions
-            if counters["taint_mask_drops"] or \
-                    counters["static_tx_prunes"] or \
-                    counters["static_facts_seeded"] or \
-                    counters["static_memo_evictions"]:
-                log.info(
-                    "Static taint/deps: mask_drops=%d tx_prunes=%d "
-                    "facts_seeded=%d memo_evictions=%d",
-                    counters["taint_mask_drops"],
-                    counters["static_tx_prunes"],
-                    counters["static_facts_seeded"],
-                    counters["static_memo_evictions"],
-                )
-            # migration-bus verdict shipping (docs/work_stealing.md):
-            # proofs exported with stolen batches / replayed from a
-            # victim's sidecar before a resume
-            if counters["verdicts_shipped"] or \
-                    counters["verdicts_replayed"]:
-                log.info(
-                    "Verdict shipping: shipped=%d replayed=%d",
-                    counters["verdicts_shipped"],
-                    counters["verdicts_replayed"],
-                )
+            for line in render.counter_lines(counters):
+                log.info("%s", line)
         except Exception:  # telemetry only, never an error path
             pass

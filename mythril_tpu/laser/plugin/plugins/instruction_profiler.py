@@ -74,95 +74,15 @@ class InstructionProfiler(LaserPlugin):
             from ....smt.solver.solver_statistics import (
                 SolverStatistics,
             )
+            from ....support.telemetry import render
 
+            # thin renderer over the shared counter-line spec
+            # (support/telemetry/render.py) — identical grouping to
+            # the benchmark plugin, drift-guarded by
+            # tests/test_counter_drift.py
             counters = SolverStatistics().batch_counters()
             lines.append("Solver batch/pipeline: {}".format(counters))
-            # run-wide verdict cache reuse tiers
-            # (docs/feasibility_cache.md)
-            lines.append(
-                "Verdict cache: hits={} unsat_kills={} shadows={} "
-                "shadow_rejects={} bound_seeds={} "
-                "queries_saved={}".format(
-                    counters["verdict_hits"],
-                    counters["verdict_unsat_kills"],
-                    counters["verdict_shadows"],
-                    counters["verdict_shadow_rejects"],
-                    counters["verdict_bound_seeds"],
-                    counters["queries_saved"],
-                ))
-            # bidirectional propagation screen (docs/propagation.md):
-            # product-domain lane kills, fixpoint sweeps, harvested
-            # facts and the solves they hinted
-            if counters["propagate_kills"] or \
-                    counters["facts_harvested"] or \
-                    counters["hinted_solves"]:
-                lines.append(
-                    "Propagation: kills={} sweeps={} facts={} "
-                    "hinted_solves={}".format(
-                        counters["propagate_kills"],
-                        counters["propagate_sweeps"],
-                        counters["facts_harvested"],
-                        counters["hinted_solves"],
-                    ))
-            # window/round-boundary lane merge (docs/lane_merge.md)
-            if counters["lanes_merged"] or \
-                    counters["lanes_subsumed"]:
-                lines.append(
-                    "Lane merge: merged={} subsumed={} rounds={} "
-                    "or_terms={}".format(
-                        counters["lanes_merged"],
-                        counters["lanes_subsumed"],
-                        counters["merge_rounds"],
-                        counters["or_terms_built"],
-                    ))
-            # persistent solver pool (docs/solver_pool.md)
-            if counters["pool_workers"] > 1 or \
-                    counters["queries_pooled"]:
-                lines.append(
-                    "Solver pool: workers={} pooled={} races={} "
-                    "race_wins={} affinity_hits={} deaths={} "
-                    "async_overlap_ms={}".format(
-                        counters["pool_workers"],
-                        counters["queries_pooled"],
-                        counters["portfolio_races"],
-                        counters["races_won_by_tactic"],
-                        counters["affinity_prefix_hits"],
-                        counters["worker_deaths"],
-                        counters["async_overlap_ms"],
-                    ))
-            # static bytecode pre-analysis (docs/static_pass.md)
-            if counters["static_blocks"] or \
-                    counters["static_retired_lanes"] or \
-                    counters["static_pruner_skips"]:
-                lines.append(
-                    "Static pass: blocks={} jumps_resolved={} "
-                    "retired={} pruner_skips={}".format(
-                        counters["static_blocks"],
-                        counters["static_jumps_resolved"],
-                        counters["static_retired_lanes"],
-                        counters["static_pruner_skips"],
-                    ))
-            # taint/dependence dataflow layer (docs/static_pass.md)
-            if counters["taint_mask_drops"] or \
-                    counters["static_tx_prunes"] or \
-                    counters["static_facts_seeded"] or \
-                    counters["static_memo_evictions"]:
-                lines.append(
-                    "Static taint/deps: mask_drops={} tx_prunes={} "
-                    "facts_seeded={} memo_evictions={}".format(
-                        counters["taint_mask_drops"],
-                        counters["static_tx_prunes"],
-                        counters["static_facts_seeded"],
-                        counters["static_memo_evictions"],
-                    ))
-            # migration-bus verdict shipping (docs/work_stealing.md)
-            if counters["verdicts_shipped"] or \
-                    counters["verdicts_replayed"]:
-                lines.append(
-                    "Verdict shipping: shipped={} replayed={}".format(
-                        counters["verdicts_shipped"],
-                        counters["verdicts_replayed"],
-                    ))
+            lines.extend(render.counter_lines(counters))
         except Exception:  # telemetry only
             pass
         for r in sorted(

@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..smt import symbol_factory
 from ..support.opcodes import OPCODES
 from ..support.support_args import args
+from ..support.telemetry import trace
 from .cfg import Edge, JumpType, Node, NodeFlags
 from .evm_exceptions import StackUnderflowException, VmException
 from .instruction_data import get_required_stack_elements
@@ -261,6 +262,13 @@ class LaserEVM:
                 i,
                 len(self.open_states),
             )
+            # svm-round span (docs/observability.md): B/E pair rather
+            # than a `with` block so the round body keeps its shape;
+            # an exception mid-round leaves the B unmatched, which
+            # Perfetto closes at trace end (and the flight recorder
+            # captures the crash anyway)
+            trace.begin("svm.round", round=i,
+                        states=len(self.open_states))
             func_hashes = (
                 args.transaction_sequences[i]
                 if args.transaction_sequences
@@ -329,6 +337,8 @@ class LaserEVM:
             if bus is not None:
                 bus.on_round_end(self, i + 1, self.transaction_count,
                                  address)
+            trace.end("svm.round",
+                      open_states=len(self.open_states))
         self.start_round = 0  # a later sym_exec must not skip rounds
         self.executed_transactions = True
 
@@ -425,6 +435,11 @@ class LaserEVM:
         (ops/propagate.py): known-bits x interval kills the forward
         pass cannot make, plus harvested facts that hint the surviving
         check_batch solves (docs/propagation.md)."""
+        with trace.span("svm.open_state_screen",
+                        n=len(open_states)):
+            return self._screen_open_states_inner(open_states)
+
+    def _screen_open_states_inner(self, open_states):
         if args.tpu_prefilter:
             try:
                 from ..models.pruner import prefilter_world_states

@@ -657,11 +657,17 @@ def _run_tables(enc: EncodedDAG):
     rows = jnp.arange(s_pad)[:, None]
     lo_tab = lo_tab.at[rows, seed_idx].set(seed_lo, mode="drop")
     hi_tab = hi_tab.at[rows, seed_idx].set(seed_hi, mode="drop")
-    for level in enc.levels:
-        arrays = {k: v for k, v in level.items() if k != "ops_present"}
-        lo_tab, hi_tab = _eval_level_jit(
-            arrays, lo_tab, hi_tab, ops_present=level["ops_present"]
-        )
+    from ..support.telemetry import trace
+
+    with trace.span("intervals.eval", states=n_states,
+                    levels=len(enc.levels)):
+        for level in enc.levels:
+            arrays = {k: v
+                      for k, v in level.items() if k != "ops_present"}
+            lo_tab, hi_tab = trace.call_jit(
+                "intervals.eval_level", _eval_level_jit,
+                arrays, lo_tab, hi_tab,
+                ops_present=level["ops_present"])
     return lo_tab, hi_tab, rows, assert_idx, assert_mask, n_states
 
 

@@ -757,7 +757,13 @@ def _run_host(arrays, statics):
     """Default driver: host-sequenced sweeps over the per-level jit
     kernels (compilations bucket and reuse across DAGs exactly like
     the forward interval screen's), one changed-flag readback per
-    sweep for the fixpoint early exit."""
+    sweep for the fixpoint early exit. Level-kernel calls route
+    through trace.call_jit so a cold XLA compile shows up as a
+    distinct `xla.compile` span, not an anonymously slow sweep (the
+    BENCH_r06 artifact class — docs/observability.md); with tracing
+    off call_jit is a direct call."""
+    from ..support.telemetry import trace
+
     cap, level_ops, back_ops = statics
     levels, back = arrays["levels"], arrays["back"]
     numeric = arrays["numeric"]
@@ -768,13 +774,16 @@ def _run_host(arrays, statics):
         prev = tabs
         lo, hi, k0, k1 = tabs
         for li, level in enumerate(levels):
-            lo, hi, k0, k1 = _fwd_level_jit(
+            lo, hi, k0, k1 = trace.call_jit(
+                "propagate.fwd_level", _fwd_level_jit,
                 level, lo, hi, k0, k1, ops_present=level_ops[li])
         lo, hi, k0, k1 = _exchange_all_jit(lo, hi, k0, k1, numeric)
         for li in range(len(levels) - 1, -1, -1):
             for ri, rnd in enumerate(back[li]):
-                lo, hi, k0, k1 = _back_round_jit(
-                    rnd, lo, hi, k0, k1, ops_present=back_ops[li][ri])
+                lo, hi, k0, k1 = trace.call_jit(
+                    "propagate.back_round", _back_round_jit,
+                    rnd, lo, hi, k0, k1,
+                    ops_present=back_ops[li][ri])
         tabs = _exchange_all_jit(lo, hi, k0, k1, numeric)
         sweeps += 1
         if not bool(_changed_jit(prev, tabs)):
@@ -1000,9 +1009,14 @@ def run(enc: EncodedDAG):
     plan = build_plan(enc)
     if plan is None:
         return None
+    from ..support.telemetry import trace
+
     driver = _fixpoint_jit if FUSE else _run_host
-    lo, hi, k0, k1, ok, _contra, sweeps = driver(
-        plan.arrays, plan.statics)
+    with trace.span("propagate.fixpoint", states=enc.n_real,
+                    fused=FUSE) as sp:
+        lo, hi, k0, k1, ok, _contra, sweeps = driver(
+            plan.arrays, plan.statics)
+        sp.set(sweeps=int(sweeps))
     keep = np.asarray(ok)[:enc.n_real] & ~np.asarray(
         enc.dead[:enc.n_real])
     return keep, (lo, hi, k0, k1), int(sweeps)

@@ -76,6 +76,13 @@ class MythrilAnalyzer:
         args.tpu_mesh = getattr(cmd_args, "tpu_mesh", args.tpu_mesh)
         args.checkpoint_file = getattr(cmd_args, "checkpoint", None)
         args.migration_bus = getattr(cmd_args, "migration_bus", None)
+        # run-wide observability (docs/observability.md): --trace-out
+        # arms span tracing and the at-exit Chrome trace export
+        args.trace_out = getattr(cmd_args, "trace_out", None)
+        if args.trace_out:
+            from ..support import telemetry
+
+            telemetry.configure(trace_out=args.trace_out, enable=True)
         from ..support.devices import effective_tpu_lanes
 
         effective_tpu_lanes()  # resolve the auto sentinel for this run

@@ -232,6 +232,13 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     into corpus_report.json."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
+    # per-rank observability (docs/observability.md): arm the crash
+    # flight recorder + slow-query log against --out-dir — a rank that
+    # dies mid-shard leaves --out-dir/flightrec/ instead of a
+    # truncated log. Span tracing stays governed by MTPU_TRACE.
+    from ..support import telemetry
+
+    telemetry.configure(out_dir=str(out), rank=process_id)
     # cost-aware LPT when a prior run left stats.json in --out-dir,
     # deterministic round-robin otherwise; long-pole contracts above
     # the perfect-balance share are pre-declared splittable so the
@@ -308,6 +315,24 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
         shard_report["solver"] = SolverStatistics().batch_counters()
     except Exception:  # telemetry only
         pass
+    try:
+        # this rank's native metrics (per-tactic solver-wall
+        # histograms, xla compile counts, span stats) ride the same
+        # shard-report/merge path as the solver counters
+        from ..support.telemetry import metrics as telemetry_metrics
+        from ..support.telemetry import trace
+
+        shard_report["metrics"] = telemetry_metrics.registry(
+        ).export_state()
+        if trace.enabled():
+            trace.export_chrome_trace(
+                out / f"trace_rank{process_id}.json",
+                rank=process_id)
+            trace.export_jsonl(
+                out / f"trace_rank{process_id}.jsonl",
+                rank=process_id)
+    except Exception:  # telemetry only
+        pass
     (out / f"shard_{process_id}.json").write_text(
         json.dumps(shard_report))
     _barrier("mythril_tpu_corpus_done")
@@ -336,7 +361,8 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
              "migrated_batches_out":
                  data.get("migrated_batches_out", 0),
              "migration": data.get("migration", {}),
-             "solver": data.get("solver", {})})
+             "solver": data.get("solver", {}),
+             "metrics": data.get("metrics", {})})
         merged["stolen"] += data.get("stolen", 0)
         for r in data["results"]:
             key = r.get("path", r["contract"])
@@ -358,13 +384,27 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
                 "midround_exports"):
         merged[key] = sum(s["migration"].get(key, 0)
                           for s in merged["shards"])
+    # corpus-wide metrics aggregate: per-rank registry states merge
+    # (counters/histograms sum, gauges max) — the structured twin of
+    # the summed migration counters above
+    merged_metrics = None
+    try:
+        from ..support.telemetry import metrics as telemetry_metrics
+
+        merged_metrics = telemetry_metrics.merge_states(
+            [s.get("metrics") for s in merged["shards"]])
+        merged["metrics"] = merged_metrics
+    except Exception:  # telemetry only
+        pass
     (out / "corpus_report.json").write_text(json.dumps(merged))
     # persist per-contract walls + fork peaks: the NEXT run over this
     # --out-dir seeds its LPT schedule and pick_width warm start from
-    # them (parallel/cost_model.py)
+    # them (parallel/cost_model.py); the merged telemetry block (per-
+    # tactic solver-wall histograms) rides along for future solver
+    # routing (ROADMAP open item 3)
     from .cost_model import save_stats
 
-    save_stats(out, merged["contracts"])
+    save_stats(out, merged["contracts"], telemetry=merged_metrics)
     return merged
 
 

@@ -100,10 +100,18 @@ def load_stats(out_dir) -> Dict[str, dict]:
         return {}
 
 
-def save_stats(out_dir, results: Sequence[dict]) -> None:
+def save_stats(out_dir, results: Sequence[dict],
+               telemetry: Optional[dict] = None) -> None:
     """Merge this run's per-contract observations into stats.json
     (atomic replace; best-effort). `results` rows carry ``contract``
-    (basename), ``wall_s``, and optionally ``fork_peak``."""
+    (basename), ``wall_s``, and optionally ``fork_peak``.
+
+    A ``telemetry`` block (support/telemetry/metrics.py export_state
+    shape — per-tactic solver-wall histograms, xla compile counts)
+    persists beside the cost model; when None, this process's own
+    registry state is used. load_stats ignores it, so the LPT warm
+    start is unaffected — it is the raw material for learned
+    per-contract solver routing (ROADMAP open item 3)."""
     out = Path(out_dir)
     prior = load_stats(out)
     for r in results:
@@ -118,10 +126,20 @@ def save_stats(out_dir, results: Sequence[dict]) -> None:
             else _EMA_ALPHA * wall + (1 - _EMA_ALPHA) * old, 3)
         peak = int(r.get("fork_peak", 0) or 0)
         entry["fork_peak"] = max(peak, int(entry.get("fork_peak", 0)))
+    if telemetry is None:
+        try:
+            from ..support.telemetry import metrics as _metrics
+
+            telemetry = _metrics.registry().export_state()
+        except Exception:
+            telemetry = None
+    payload = {"version": 1, "contracts": prior}
+    if telemetry:
+        payload["telemetry"] = telemetry
     try:
         fd, tmp = tempfile.mkstemp(dir=str(out), prefix=".stats-")
         with os.fdopen(fd, "w") as f:
-            json.dump({"version": 1, "contracts": prior}, f)
+            json.dump(payload, f)
         os.replace(tmp, out / STATS_NAME)
     except Exception as e:  # pragma: no cover - best-effort by design
         log.warning("stats save failed (%s)", e)

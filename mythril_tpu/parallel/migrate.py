@@ -58,9 +58,47 @@ import shutil
 import threading
 import time
 from pathlib import Path
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from ..support.telemetry import trace
 
 log = logging.getLogger(__name__)
+
+
+class _StalenessClock:
+    """Monotonic staleness for heartbeat files. File mtimes are WALL
+    times set by another host's clock: comparing them against this
+    process's ``time.time()`` made the dead-thief timeout wrong by
+    exactly any NTP step (or cross-host clock skew) during a long
+    corpus run. The mtime is therefore used only as a change DETECTOR:
+    staleness is measured on this process's monotonic clock from the
+    moment it last OBSERVED the mtime change. First observation counts
+    as fresh — a genuinely dead peer's file then ages out after one
+    full timeout of observed silence, which is the conservative side
+    (work is re-run late, never lost or double-run early)."""
+
+    def __init__(self):
+        self._seen: Dict[str, tuple] = {}  # path -> (mtime, mono)
+
+    def age(self, *paths) -> float:
+        """Monotonic seconds since the freshest of `paths` last
+        changed; +inf when none exists."""
+        now = time.monotonic()
+        best = None
+        for p in paths:
+            key = str(p)
+            try:
+                mtime = os.stat(key).st_mtime
+            except OSError:
+                continue
+            prev = self._seen.get(key)
+            if prev is None or prev[0] != mtime:
+                self._seen[key] = (mtime, now)
+                cur = 0.0
+            else:
+                cur = now - prev[1]
+            best = cur if best is None else min(best, cur)
+        return best if best is not None else float("inf")
 
 #: how long a victim waits on a CLAIMED offer after every other rank
 #: reported done (a live thief answers in far less; a dead one never)
@@ -134,6 +172,10 @@ class MigrationBus:
         }
         self._req_cache: Optional[tuple] = None
         self._victim_hb: Optional[_Heartbeat] = None
+        #: monotonic change-observation clock for every peer
+        #: heartbeat file this bus judges staleness on (request files,
+        #: claim files, its own offer metas)
+        self._stale = _StalenessClock()
 
     @property
     def yield_every(self) -> int:
@@ -160,7 +202,7 @@ class MigrationBus:
         Results are memoized for `max_age` seconds: the mid-round
         yield polls every K processed states and must not turn the
         exploration loop into a glob loop."""
-        now = time.time()
+        now = time.monotonic()
         if (self._req_cache is not None
                 and now - self._req_cache[0] < max_age):
             return self._req_cache[1]
@@ -169,10 +211,9 @@ class MigrationBus:
             rank = int(p.name.split("_")[1])
             if rank == self.rank:
                 continue
-            try:
-                if now - p.stat().st_mtime > CLAIMED_WAIT_S:
-                    continue
-            except OSError:
+            # staleness on the MONOTONIC observation clock, not wall
+            # vs mtime (NTP steps corrupted the dead-thief cutoff)
+            if self._stale.age(p) > CLAIMED_WAIT_S:
                 continue
             out.append(rank)
         self._req_cache = (now, out)
@@ -315,6 +356,8 @@ class MigrationBus:
             self.stats["states_migrated"] += len(chunk)
             self.stats["batches_out"] += 1
             published += 1
+            trace.event("migrate.offer", offer=offer_id,
+                        states=len(chunk), round=next_round)
             log.info("rank %d: migrated %d open states (offer %s, "
                      "%d thieves idle)", self.rank, len(chunk),
                      offer_id, len(thieves))
@@ -427,15 +470,13 @@ class MigrationBus:
                 # so a thief that claimed long before the victim got
                 # here is never raced with a duplicate local run just
                 # because the victim's analysis outlived the timeout.
-                age_ref = 0.0
-                for p in (claim, meta_path):
-                    try:
-                        age_ref = max(age_ref, p.stat().st_mtime)
-                    except OSError:
-                        pass
-                if time.time() - age_ref > CLAIMED_WAIT_S:
+                # Staleness is monotonic-observed (see _StalenessClock)
+                # — a wall-clock step can no longer declare a live
+                # thief dead (or keep a dead one alive).
+                if self._stale.age(claim, meta_path) > CLAIMED_WAIT_S:
                     log.warning("offer %s claimed but never answered; "
                                 "re-running locally", offer_id)
+                    trace.event("migrate.dead_thief", offer=offer_id)
                     break
             time.sleep(0.2)
         # local fallback: resume the batch with this rank's own engine
@@ -470,6 +511,7 @@ class MigrationBus:
                         first_claim = time.perf_counter() - t_request
                         self.stats["steal_latency_s"] = round(
                             first_claim, 3)
+                    trace.event("migrate.claim", offer=offer_id)
                     took = True
                     if self._run_offer(offer_id, meta_path):
                         served += 1
@@ -569,6 +611,8 @@ def analyze_batch(meta: dict, batch_path, timeout: int,
                 if vc is not None else []
             if entries:
                 n = vc.import_entries(entries)
+                trace.event("migrate.replay", verdicts=n,
+                            batch=Path(batch_path).name)
                 log.info("replayed %d shipped verdicts for batch %s",
                          n, Path(batch_path).name)
         except Exception as e:

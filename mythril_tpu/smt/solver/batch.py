@@ -203,13 +203,18 @@ def discharge(
     K > 1) the surviving queries fan out over the pool's worker
     sessions with trie-subtree affinity — see _discharge_pooled; at
     K=1 this serial body runs unchanged."""
+    from ...support.telemetry import trace
+
     pool = pool_mod.get_pool()
-    if pool.parallel:
-        return _discharge_pooled(
-            pool, term_sets, timeout_s, conflict_budget, quick_sat,
-            on_sat_model, registry)
-    return _discharge_serial(term_sets, timeout_s, conflict_budget,
-                             quick_sat, on_sat_model, registry)
+    with trace.span("solver.discharge", n=len(term_sets),
+                    pooled=pool.parallel):
+        if pool.parallel:
+            return _discharge_pooled(
+                pool, term_sets, timeout_s, conflict_budget,
+                quick_sat, on_sat_model, registry)
+        return _discharge_serial(term_sets, timeout_s,
+                                 conflict_budget, quick_sat,
+                                 on_sat_model, registry)
 
 
 def _discharge_serial(
@@ -296,8 +301,12 @@ def _discharge_serial(
         if hints:
             ss.bump(hinted_solves=1)
         try:
-            ctx = core.check(hints + list(work), timeout_s=timeout_s,
-                             conflict_budget=conflict_budget)
+            from ...support.telemetry import trace
+
+            with trace.query_context(tier="batch.serial"):
+                ctx = core.check(hints + list(work),
+                                 timeout_s=timeout_s,
+                                 conflict_budget=conflict_budget)
         except (KeyboardInterrupt, MemoryError):
             raise  # fatal, never a degrade (the _device_failed class)
         except Exception as e:  # degraded, never wrong: keep the query
@@ -426,8 +435,12 @@ def _discharge_pooled(pool, term_sets, timeout_s, conflict_budget,
             if hints:
                 ss.bump(hinted_solves=1)
             try:
-                ctx = pool.solve_query(hints + list(work), timeout_s,
-                                       conflict_budget)
+                from ...support.telemetry import trace
+
+                with trace.query_context(tier="batch.pooled"):
+                    ctx = pool.solve_query(hints + list(work),
+                                           timeout_s,
+                                           conflict_budget)
             except (KeyboardInterrupt, MemoryError):
                 raise  # fatal, never a degrade
             except Exception as e:  # degraded, never wrong
@@ -485,8 +498,11 @@ def _serial_requery(i, norm, registry, vc, timeout_s, conflict_budget,
     if hints:
         ss.bump(hinted_solves=1)
     try:
-        ctx = core.check(hints + list(work), timeout_s=timeout_s,
-                         conflict_budget=conflict_budget)
+        from ...support.telemetry import trace
+
+        with trace.query_context(tier="batch.requery"):
+            ctx = core.check(hints + list(work), timeout_s=timeout_s,
+                             conflict_budget=conflict_budget)
     except (KeyboardInterrupt, MemoryError):
         raise  # fatal, never a degrade
     except Exception as e:

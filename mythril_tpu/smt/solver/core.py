@@ -429,6 +429,27 @@ def thread_query_count() -> int:
 #: unsat-core subsumption effectiveness (read by bench detail)
 CORE_STATS = {"cached": 0, "hits": 0}
 
+#: in-flight query registry (crash flight recorder,
+#: support/telemetry/flightrec.py): every live check() registers its
+#: constraint-set fingerprint here so a dying rank can dump what its
+#: solvers were chewing on. Keyed by (thread ident, per-thread seq).
+_INFLIGHT: Dict[tuple, dict] = {}
+_INFLIGHT_LOCK = threading.Lock()
+
+
+def inflight_queries() -> List[dict]:
+    """Snapshot of currently-solving queries: fingerprint tids, tier/
+    tactic attribution, budget, and monotonic age in seconds."""
+    now = time.monotonic()
+    with _INFLIGHT_LOCK:
+        entries = list(_INFLIGHT.values())
+    out = []
+    for e in entries:
+        d = dict(e)
+        d["age_s"] = round(now - d.pop("t0"), 3)
+        out.append(d)
+    return out
+
 # set False to fall back to one-shot solving (fresh instance per query)
 INCREMENTAL = True
 
@@ -632,19 +653,55 @@ def check(
     the fresh-solve entry every cache/screen layer above bottoms out in,
     so `query_count`/`solver_time` measure actual solver work (the
     batched discharge reads the per-thread delta to tell a cache hit
-    from a solve)."""
+    from a solve). Each call also registers in the in-flight registry
+    (flight recorder), records a `solver.check` span when tracing is
+    on, feeds the per-tactic wall histogram, and lands in the
+    slow-query log when it exceeds MTPU_SLOW_QUERY_MS
+    (docs/observability.md)."""
+    from ...support.telemetry import metrics, slowlog
+    from ...support.telemetry import trace
     from .solver_statistics import SolverStatistics
 
     ss = SolverStatistics()
     ss.bump(query_count=1)
     _tls.qcount = getattr(_tls, "qcount", 0) + 1
+    qctx = trace.current_query_context()
+    tactic = qctx.get("tactic") or (
+        "oneshot" if force_oneshot else "incremental")
+    tier = qctx.get("tier", "direct")
     t_q = time.monotonic()
+    key = (threading.get_ident(), _tls.qcount)
+    tids = [a.tid for a in assertions]
+    with _INFLIGHT_LOCK:
+        _INFLIGHT[key] = {"tids": tids, "tier": tier,
+                          "tactic": tactic, "timeout_s": timeout_s,
+                          "t0": t_q}
+    status = "error"
     try:
-        return _check_unmeasured(assertions, timeout_s, conflict_budget,
-                                 minimize, maximize, phase_hint,
-                                 cancel, force_oneshot)
+        with trace.span("solver.check", tier=tier, tactic=tactic,
+                        n=len(assertions)) as sp:
+            ctx = _check_unmeasured(assertions, timeout_s,
+                                    conflict_budget, minimize,
+                                    maximize, phase_hint, cancel,
+                                    force_oneshot)
+            status = ctx.status
+            sp.set(status=status)
+        return ctx
     finally:
-        ss.bump(solver_time=time.monotonic() - t_q)
+        wall = time.monotonic() - t_q
+        ss.bump(solver_time=wall)
+        with _INFLIGHT_LOCK:
+            _INFLIGHT.pop(key, None)
+        try:
+            metrics.registry().histogram(
+                "solver_wall_ms." + tactic).observe(wall * 1000.0)
+            slowlog.maybe_record(
+                wall * 1000.0, tids=tids, tier=tier, tactic=tactic,
+                timeout_s=timeout_s, status=status)
+        except (KeyboardInterrupt, MemoryError):
+            raise  # fatal, never a degrade
+        except Exception:  # telemetry only, never a solve path
+            pass
 
 
 def _check_unmeasured(

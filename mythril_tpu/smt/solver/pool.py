@@ -174,7 +174,11 @@ class _Worker:
                 inject = self.pool.fail_injector
                 if inject is not None:
                     inject(self.idx, task)
-                task.result = task.fn()
+                from ...support.telemetry import trace
+
+                with trace.span("solver.pooled_task",
+                                worker=self.idx):
+                    task.result = task.fn()
                 task.done.set()
             except Exception as e:
                 # unexpected failure: this worker's session may be
@@ -377,30 +381,36 @@ class SolverPool:
         preprocessing diversity that pays off exactly when the
         incremental attack is stuck. The first definitive verdict
         interrupts the other via the RaceToken."""
+        from ...support.telemetry import trace
+
         ss = SolverStatistics()
         ss.bump(portfolio_races=1)
         token = RaceToken()
 
         def attack(tactic: str, force_oneshot: bool) -> None:
             try:
-                ctx = core.check(
-                    work, timeout_s=timeout_s,
-                    conflict_budget=conflict_budget,
-                    cancel=token.cancelled,
-                    force_oneshot=force_oneshot,
-                )
+                with trace.query_context(tier="pool.race",
+                                         tactic=tactic):
+                    ctx = core.check(
+                        work, timeout_s=timeout_s,
+                        conflict_budget=conflict_budget,
+                        cancel=token.cancelled,
+                        force_oneshot=force_oneshot,
+                    )
             except Exception as e:  # a racer, never an error path
                 log.debug("race tactic %s failed: %s", tactic, e)
                 return
             if ctx.status in (SAT, UNSAT) and token.win(tactic, ctx):
                 ss.bump_race_win(tactic)
 
-        rival = threading.Thread(
-            target=attack, args=("oneshot", True),
-            name="mtpu-race-oneshot", daemon=True)
-        rival.start()
-        attack("incremental", False)
-        rival.join()
+        with trace.span("solver.race", n=len(work)) as sp:
+            rival = threading.Thread(
+                target=attack, args=("oneshot", True),
+                name="mtpu-race-oneshot", daemon=True)
+            rival.start()
+            attack("incremental", False)
+            rival.join()
+            sp.set(winner=token.winner or "none")
         return token.ctx
 
     def solve_query(self, work, timeout_s: float, conflict_budget: int):
@@ -419,8 +429,11 @@ class SolverPool:
             else:
                 first_cb = self.first_conflicts
         t0 = time.monotonic()
-        ctx = core.check(work, timeout_s=first_to,
-                         conflict_budget=first_cb)
+        from ...support.telemetry import trace
+
+        with trace.query_context(tier="pool.first"):
+            ctx = core.check(work, timeout_s=first_to,
+                             conflict_budget=first_cb)
         if ctx.status != UNKNOWN or not escalate:
             return ctx
         # the race budget is the NOMINAL remainder, floored at a

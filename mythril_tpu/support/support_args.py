@@ -50,6 +50,10 @@ class Args(object, metaclass=Singleton):
         self.checkpoint_file = None
         # corpus-mode path-batch migration bus (parallel/migrate.py)
         self.migration_bus = None
+        # --trace-out: Chrome trace-event JSON export path for the
+        # run-wide span tracer (support/telemetry/,
+        # docs/observability.md); None = no export
+        self.trace_out = None
 
 
 args = Args()

@@ -1,0 +1,111 @@
+"""Counter-drift guard (support/telemetry/render.py).
+
+PRs 4-8 each hand-wired new SolverStatistics counters into 4+ render
+sites by review. These tests make the drift a TEST FAILURE instead:
+every key `batch_counters()` exposes must be covered by the shared
+render-group spec, both telemetry plugins must render through that
+spec, and the bench/corpus detail blocks must render the counter dict
+GENERICALLY (so a new key cannot silently miss them)."""
+
+import logging
+from pathlib import Path
+
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support.telemetry import render
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_every_batch_counter_is_rendered():
+    """covered_keys() must EQUAL the batch_counters key set — a new
+    counter without a render-group entry (or a dangling entry for a
+    removed counter) fails here, not in review."""
+    keys = set(SolverStatistics().batch_counters().keys())
+    covered = render.covered_keys()
+    assert covered == keys, (
+        "counter/render drift:\n"
+        f"  counters missing a render line: {sorted(keys - covered)}\n"
+        f"  render entries without a counter: "
+        f"{sorted(covered - keys)}")
+
+
+def test_counter_lines_carry_every_value():
+    """Rendered lines must show each counter's VALUE, not just exist:
+    sentinel values round-trip into the group lines."""
+    counters = SolverStatistics().batch_counters()
+    sentinel = {k: (i + 2) if not isinstance(v, dict) else {"t": i}
+                for i, (k, v) in enumerate(sorted(counters.items()))}
+    lines = render.counter_lines(sentinel, always=True)
+    blob = "\n".join(lines)
+    for _label, _doc, _gate, pairs in render.GROUPS:
+        for disp, key in pairs:
+            assert "{}={}".format(disp, sentinel[key]) in blob, (
+                f"counter {key} (as {disp}) not rendered")
+
+
+def test_gated_groups_hide_when_zero():
+    zeros = {k: 0 if not isinstance(v, dict) else {}
+             for k, v in SolverStatistics().batch_counters().items()}
+    lines = render.counter_lines(zeros)
+    blob = "\n".join(lines)
+    # always-on groups stay...
+    assert "Batched discharge:" in blob
+    assert "Verdict cache:" in blob
+    # ...gated ones hide at zero (matching the old plugins' behavior)
+    assert "Lane merge:" not in blob
+    assert "Static taint/deps:" not in blob
+    # and engage when their gate counters go nonzero
+    zeros["lanes_merged"] = 1
+    assert "Lane merge:" in "\n".join(render.counter_lines(zeros))
+
+
+def test_benchmark_plugin_renders_through_shared_groups(caplog):
+    from mythril_tpu.laser.plugin.plugins.benchmark import (
+        BenchmarkPlugin,
+    )
+
+    plugin = BenchmarkPlugin()
+    plugin.begin = 0.0
+    plugin.end = 1.0
+    with caplog.at_level(logging.INFO,
+                         logger="mythril_tpu.laser.plugin.plugins"
+                                ".benchmark"):
+        plugin._write_results()
+    blob = "\n".join(r.getMessage() for r in caplog.records)
+    assert "Solver batch/pipeline:" in blob
+    assert "Batched discharge:" in blob
+    assert "Verdict cache:" in blob
+
+
+def test_instruction_profiler_renders_through_shared_groups():
+    from mythril_tpu.laser.plugin.plugins.instruction_profiler import (
+        InstructionProfiler,
+    )
+
+    summary = InstructionProfiler()._make_summary()
+    assert "Solver batch/pipeline:" in summary
+    assert "Batched discharge:" in summary
+    assert "Verdict cache:" in summary
+
+
+def test_plugins_are_thin_renderers():
+    """Both plugins must route through render.counter_lines — a
+    hand-wired per-plugin line is exactly the drift this guard
+    exists to kill."""
+    for rel in ("mythril_tpu/laser/plugin/plugins/benchmark.py",
+                "mythril_tpu/laser/plugin/plugins/"
+                "instruction_profiler.py"):
+        src = (REPO / rel).read_text()
+        assert "counter_lines" in src, f"{rel} bypasses the renderer"
+
+
+def test_detail_blocks_render_counters_generically():
+    """bench.py's smoke detail, bench_corpus's aggregate and the
+    corpus shard report must iterate batch_counters() as a dict (so
+    every present AND future key ships) rather than naming keys."""
+    bench = (REPO / "bench.py").read_text()
+    assert "ss.batch_counters().items()" in bench
+    corpus_bench = (REPO / "bench_corpus.py").read_text()
+    assert "batch_counters()" in corpus_bench
+    corpus = (REPO / "mythril_tpu/parallel/corpus.py").read_text()
+    assert "batch_counters()" in corpus

@@ -153,3 +153,43 @@ def test_broad_except_outside_rule3_roots_ok(tmp_path):
                 return None
     """)
     assert findings == []
+
+
+def test_wall_clock_in_parallel_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/parallel/bad.py", """\
+        import time
+
+        def latency(t0):
+            return time.time() - t0
+    """)
+    assert [f.rule for f in findings] == ["wall-clock-in-monotonic-path"]
+    assert findings[0].line == 4
+
+
+def test_wall_clock_in_telemetry_flagged(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/support/telemetry/bad.py", """\
+        import time
+        STAMP = time.time()
+    """)
+    assert [f.rule for f in findings] == ["wall-clock-in-monotonic-path"]
+
+
+def test_monotonic_in_parallel_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/parallel/good.py", """\
+        import time
+
+        def latency(t0):
+            return time.monotonic() - t0
+    """)
+    assert findings == []
+
+
+def test_wall_clock_outside_rule4_roots_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/analysis/ok.py", """\
+        import time
+
+        def stamp():
+            return time.time()
+    """)
+    assert findings == []

@@ -33,6 +33,16 @@ instead of dying loudly — exactly the bug PR 5 fixed in
 models/pruner._device_failed. Deliberate telemetry/fallback sites are
 allowlisted with reasons.
 
+Rule 4 — wall-clock-in-monotonic-path (the PR-9 steal-latency
+class): calling ``time.time()`` inside ``mythril_tpu/parallel/`` or
+``mythril_tpu/support/telemetry/``. Those packages measure latencies
+and staleness (steal latency, offer-heartbeat dead-thief clocks, span
+timing) — an NTP step on a long corpus run silently corrupts any
+wall-clock interval there. Use ``time.monotonic()`` (or
+``time.perf_counter()`` for sub-second spans); true wall TIMESTAMPS
+(not intervals) should come from ``datetime`` so the intent is
+explicit.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -99,6 +109,18 @@ _BROAD_EXC = frozenset(("Exception", "BaseException"))
 _FATAL_EXC = frozenset(("KeyboardInterrupt", "MemoryError"))
 #: rule-3 scope: the layers every retry/backoff loop funnels through
 _RULE3_ROOTS = ("mythril_tpu/ops/", "mythril_tpu/smt/solver/")
+#: rule-4 scope: latency/staleness-measuring packages where a
+#: wall-clock interval is a latent NTP-step bug
+_RULE4_ROOTS = ("mythril_tpu/parallel/",
+                "mythril_tpu/support/telemetry/")
+
+
+def _is_wall_clock_call(node: ast.Call) -> bool:
+    """time.time(...) — the wall clock with a monotonic-looking API."""
+    fn = node.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "time"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "time")
 
 
 def _exc_names(node) -> set:
@@ -209,6 +231,16 @@ def lint_file(path: Path) -> List[Finding]:
 
     if any(rel.startswith(root) for root in _RULE3_ROOTS):
         out.extend(_broad_except_findings(rel, tree))
+
+    if any(rel.startswith(root) for root in _RULE4_ROOTS):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_wall_clock_call(node):
+                out.append(Finding(
+                    rel, node.lineno, "wall-clock-in-monotonic-path",
+                    "time.time() in a latency/staleness path (NTP "
+                    "steps corrupt wall intervals; use "
+                    "time.monotonic(), or datetime for true "
+                    "timestamps)"))
     return out
 
 
