@@ -1151,7 +1151,8 @@ def _smoke_propagate():
     return result
 
 
-def build_diamond_contract(k=6, dup_levels=2, tail=True):
+def build_diamond_contract(k=6, dup_levels=2, tail=True,
+                           uneven_gas=0):
     """k gas- AND step-balanced CFG diamonds (a fork storm of rejoining
     paths): level i forks on a calldata bit, both arms execute the SAME
     instruction count and gas (JUMPDEST, PUSH2 R, JUMP on each side),
@@ -1161,7 +1162,15 @@ def build_diamond_contract(k=6, dup_levels=2, tail=True):
     interns to one tid, so `{c}`-vs-`{c,¬c}` superset subsumption
     provably fires), the rest fork on distinct bits. The optional tail
     forks on calldata word 31 == 0xdeadbeef into an INVALID (one
-    reachable Exception State issue for identity gating)."""
+    reachable Exception State issue for identity gating).
+
+    ``uneven_gas=p > 0`` inserts p*2^i stack-neutral filler PAIRS into
+    BOTH arms of level i — PUSH1/POP (5 gas) on the fall side,
+    CALLER/POP (4 gas) on the taken side — so the arms stay in device
+    LOCKSTEP (identical pc/stack at every rejoin) while every branch
+    choice lands on a unique total gas: the widened-diamond shape
+    only the gas-widening merge (MTPU_MERGE_GASWIDEN,
+    docs/lane_merge.md) can collapse."""
     from mythril_tpu.support.opcodes import ADDRESS, OPCODES
 
     op = {name: data[ADDRESS] for name, data in OPCODES.items()}
@@ -1178,12 +1187,17 @@ def build_diamond_contract(k=6, dup_levels=2, tail=True):
         c += push(0, 2) + bytes([op["JUMPI"]])
         # fall arm: JUMPDEST (step/gas balance), PUSH2 R, JUMP
         c += bytes([op["JUMPDEST"]])
+        for _ in range(uneven_gas * (1 << i)):
+            c += push(0) + bytes([op["POP"]])  # 5 gas / 2 steps
         jf = len(c)
         c += push(0, 2) + bytes([op["JUMP"]])
         t = len(c)
         c[j + 1:j + 3] = t.to_bytes(2, "big")
         # taken arm: JUMPDEST, PUSH2 R, JUMP — same 3 steps, 12 gas
+        # (uneven_gas: same STEPS, 1 less gas per filler pair)
         c += bytes([op["JUMPDEST"]])
+        for _ in range(uneven_gas * (1 << i)):
+            c += bytes([op["CALLER"], op["POP"]])  # 4 gas / 2 steps
         jt = len(c)
         c += push(0, 2) + bytes([op["JUMP"]])
         r = len(c)
@@ -1237,15 +1251,16 @@ def _smoke_merge():
     code = build_diamond_contract(k=6, dup_levels=2)
     ss = SolverStatistics()
 
-    def analyze(merge_on, tpu_lanes, tx_count):
+    def analyze(merge_on, tpu_lanes, tx_count, contract=None):
         merge_mod.FORCE = merge_on
         try:
             reset_analysis_state()
             c0 = dict(ss.batch_counters())
             lane_engine.RUN_STATS_TOTAL = {}
             dis = MythrilDisassembler(eth=None)
-            address, _ = dis.load_from_bytecode(code.hex(),
-                                                bin_runtime=True)
+            address, _ = dis.load_from_bytecode(
+                (contract if contract is not None else code).hex(),
+                bin_runtime=True)
             analyzer = MythrilAnalyzer(
                 disassembler=dis,
                 cmd_args=make_cmd_args(execution_timeout=120,
@@ -1261,23 +1276,41 @@ def _smoke_merge():
                 "counters": {k: round(c1[k] - c0.get(k, 0), 1)
                              for k in ("lanes_merged", "lanes_subsumed",
                                        "merge_rounds", "or_terms_built",
+                                       "gas_widened_lanes",
                                        "batch_queries")},
                 "parked": eng.get("parked", 0),
             }
         finally:
             merge_mod.FORCE = None
 
+    # step-balanced / gas-UNBALANCED diamond: the widened-merge rig
+    wcode = build_diamond_contract(k=4, dup_levels=0, uneven_gas=1)
     lane_engine.PATH_HISTORY[code] = 64
+    lane_engine.PATH_HISTORY[wcode] = 64
     lane_engine.FORCE_WIDTH = 64
     old_window = lane_engine.DEFAULT_WINDOW
     lane_engine.DEFAULT_WINDOW = 32
+    widen_env = os.environ.get("MTPU_MERGE_GASWIDEN")
     try:
         lane_engine.warm_variant(
             64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
             seed_bucket=16, block=True)
         lane_off = analyze(False, 64, 1)
         lane_on = analyze(True, 64, 1)
+        # gas-widening sub-gate (docs/lane_merge.md): the uneven
+        # diamond is invisible to the gas-exact merge and collapses
+        # only when widening relaxes the twin key — with issue
+        # identity across widen-on/widen-off/merge-off
+        os.environ["MTPU_MERGE_GASWIDEN"] = "0"
+        widen_off = analyze(True, 64, 1, contract=wcode)
+        os.environ["MTPU_MERGE_GASWIDEN"] = "1"
+        widen_on = analyze(True, 64, 1, contract=wcode)
+        widen_base = analyze(False, 64, 1, contract=wcode)
     finally:
+        if widen_env is None:
+            os.environ.pop("MTPU_MERGE_GASWIDEN", None)
+        else:
+            os.environ["MTPU_MERGE_GASWIDEN"] = widen_env
         lane_engine.FORCE_WIDTH = None
         lane_engine.DEFAULT_WINDOW = old_window
     host_off = analyze(False, 0, 2)
@@ -1301,6 +1334,14 @@ def _smoke_merge():
                                "merge_on": hc["batch_queries"]},
             "issues_identical": host_on["issues"] == host_off["issues"],
         },
+        "gas_widen": {
+            "widened_lanes": widen_on["counters"]["gas_widened_lanes"],
+            "merged": {"widen_on": widen_on["counters"]["lanes_merged"],
+                       "widen_off":
+                       widen_off["counters"]["lanes_merged"]},
+            "issues_identical": widen_on["issues"]
+            == widen_off["issues"] == widen_base["issues"],
+        },
         "issues": lane_on["issues"],
     }
     result["ok"] = bool(
@@ -1313,6 +1354,11 @@ def _smoke_merge():
         < host_off["counters"]["batch_queries"]
         and result["host"]["issues_identical"]
         and len(lane_on["issues"]) > 0
+        and widen_on["counters"]["lanes_merged"] > 0
+        and widen_on["counters"]["gas_widened_lanes"] > 0
+        and widen_off["counters"]["lanes_merged"] == 0
+        and result["gas_widen"]["issues_identical"]
+        and len(widen_base["issues"]) > 0
     )
     return result
 
@@ -1798,10 +1844,243 @@ def _smoke_trace():
     return result
 
 
+def build_longpole_contract(k=6):
+    """k sequential symbolic branches, each arm with a DISTINCT SSTORE
+    (so no two paths ever merge), and an assert-style INVALID tail:
+    2^k slow-to-finish paths with zero early completions — the
+    single-giant-round long-pole shape the mid-flight wave split
+    exists for (docs/checkpoint.md)."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray(push(0))
+    for i in range(k):
+        c += push(i) + bytes([op["CALLDATALOAD"]])
+        c += push(1) + bytes([op["AND"], op["ISZERO"]])
+        j = len(c)
+        c += push(0, 2) + bytes([op["JUMPI"]])
+        c += push(7 + i) + bytes([op["ADD"], op["DUP1"]])
+        c += push(i) + bytes([op["SSTORE"]])
+        c[j + 1:j + 3] = len(c).to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"]])
+    c += bytes([op["POP"]])
+    c += push(31) + bytes([op["CALLDATALOAD"]])
+    c += push(0xDEADBEEF, 4) + bytes([op["EQ"]])
+    j = len(c)
+    c += push(0, 2) + bytes([op["JUMPI"]])
+    c += bytes([op["STOP"]])
+    c[j + 1:j + 3] = len(c).to_bytes(2, "big")
+    c += bytes([op["JUMPDEST"], 0xFE])
+    return bytes(c)
+
+
+def _smoke_ckpt():
+    """Stage 11: the window-boundary lane-plane checkpointing gate
+    (docs/checkpoint.md).
+
+    Phase A — mid-flight wave splitting on a rigged two-rank SINGLE-
+    GIANT-ROUND long pole. The heavy contract runs ONE transaction
+    round (MTPU_CORPUS_TX=1) whose 2^6 paths each sleep
+    MTPU_PATH_DELAY wherever they execute: every state that finishes
+    the round has no rounds left, so the PR-3 finished-state mid-round
+    yield provably cannot ship anything — only splitting the LIVE
+    worklist can balance the ranks. Contract-level stealing is off
+    (--no-steal) in every run. Gates:
+
+    * merged issue reports IDENTICAL with live checkpointing on
+      (default) vs off (MTPU_CKPT=0);
+    * with it on, nonzero ``midflight_steals`` (a live wave actually
+      split) and max-rank wall <= 1.5x the mean — a timeout-bound
+      win per the single-CPU wall-gate constraint (the work is
+      sleep-shaped on every rank, so redistribution is observable on
+      one shared CPU);
+    * with it off, the long pole is unsheddable (imbalance reported
+      for contrast, not gated — it documents the hole being closed).
+
+    Phase B — crash-resume: a STANDALONE corpus run is SIGKILLed
+    mid-round (after its round-boundary checkpoint landed), then
+    restarted over the same --out-dir. Completed contracts' done-rows
+    adopt, the interrupted contract RESUMES from its per-contract
+    checkpoint, and the final report must be identical to an
+    uninterrupted run."""
+    import shutil
+    import signal as signal_mod
+    import socket
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from tests.fixture_paths import INPUTS
+
+    tmp = Path(tempfile.mkdtemp(prefix="mtpu_ckpt_smoke_"))
+    heavy_code = build_longpole_contract(k=6)
+    light = "nonascii.sol.o"
+
+    files = []
+    heavy_path = tmp / "a_longpole.sol.o"
+    heavy_path.write_text(heavy_code.hex())
+    files.append(str(heavy_path))
+    for name in ("b", "c", "d"):
+        dst = tmp / f"{name}_{light}"
+        shutil.copy(INPUTS / light, dst)
+        files.append(str(dst))
+
+    def _run_two_rank(out_name, ckpt_on):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out_dir = tmp / out_name
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            env["MTPU_PATH_DELAY"] = "0.4"
+            env["MTPU_MIDROUND_K"] = "64"
+            env["MTPU_CORPUS_TX"] = "1"  # the single giant round
+            env["MTPU_MIDFLIGHT_COOLDOWN"] = "0.5"
+            env["MTPU_CKPT"] = "1" if ckpt_on else "0"
+            cmd = [sys.executable, "-m",
+                   "mythril_tpu.parallel.corpus",
+                   "--coordinator", f"127.0.0.1:{port}",
+                   "--num-processes", "2", "--process-id", str(rank),
+                   "--out-dir", str(out_dir), "--timeout", "120",
+                   "--no-steal", "--migrate"]
+            procs.append(subprocess.Popen(
+                cmd + files,
+                cwd=str(Path(__file__).resolve().parent),
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=420) for p in procs]
+        for p, (_, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"ckpt-smoke rank failed:\n{err[-2000:]}")
+        return json.loads(
+            (out_dir / "corpus_report.json").read_text())
+
+    def _canon(report):
+        return [(c["contract"], c.get("issues"), c.get("swc"))
+                for c in report["contracts"]]
+
+    t0 = time.perf_counter()
+    try:
+        moved = _run_two_rank("ckpt_on", ckpt_on=True)
+        plain = _run_two_rank("ckpt_off", ckpt_on=False)
+    except Exception as e:
+        shutil.rmtree(tmp, ignore_errors=True)
+        return {"error": type(e).__name__, "detail": str(e)[:500],
+                "ok": False}
+
+    # Phase B: SIGKILL a standalone run mid-round, restart, compare
+    def _standalone(out_dir, env_extra, wait_kill=False):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["MTPU_CORPUS_TX"] = "2"
+        env.update(env_extra)
+        cmd = [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+               "--out-dir", str(out_dir), "--timeout", "120"]
+        crash_files = [str(tmp / f"b_{light}"),
+                       str(tmp / "z_longpole.sol.o")]
+        proc = subprocess.Popen(
+            cmd + crash_files,
+            cwd=str(Path(__file__).resolve().parent), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        if not wait_kill:
+            out, err = proc.communicate(timeout=420)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"ckpt-smoke standalone failed:\n{err[-2000:]}")
+            return json.loads(
+                (Path(out_dir) / "corpus_report.json").read_text())
+        # wait for the heavy contract's round-boundary checkpoint,
+        # then kill MID-round-1 — the restart must resume from it
+        ckpt_file = Path(out_dir) / "ckpt" / "z_longpole.sol.o.ckpt"
+        deadline = time.monotonic() + 180
+        while not ckpt_file.exists():
+            if proc.poll() is not None or time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError(
+                    "heavy contract never checkpointed: "
+                    + proc.communicate()[1][-1500:])
+            time.sleep(0.2)
+        time.sleep(1.5)  # well inside the delayed round 1
+        proc.send_signal(signal_mod.SIGKILL)
+        proc.communicate(timeout=60)
+        return None
+
+    crash_gates = {}
+    try:
+        # the heavy contract sorts LAST here so the light one
+        # completes (done-row written) before the kill lands
+        (tmp / "z_longpole.sol.o").write_text(
+            build_longpole_contract(k=3).hex())
+        base = _standalone(tmp / "crash_base", {})
+        _standalone(tmp / "crash_run",
+                    {"MTPU_PATH_DELAY": "0.3"}, wait_kill=True)
+        crash_gates["ckpt_written"] = (
+            tmp / "crash_run" / "ckpt" / "z_longpole.sol.o.ckpt"
+        ).exists()
+        crash_gates["done_rows"] = bool(list(
+            (tmp / "crash_run" / "done").glob("*.json")))
+        restarted = _standalone(tmp / "crash_run", {})
+        crash_gates["report_identical"] = _canon(restarted) == \
+            _canon(base)
+    except Exception as e:
+        crash_gates["error"] = f"{type(e).__name__}: {e}"[:400]
+    wall = round(time.perf_counter() - t0, 1)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    gates = {
+        "reports_identical": _canon(plain) == _canon(moved),
+        "midflight_steals": moved.get("midflight_steals", 0) > 0,
+        "wall_balanced": moved.get("wall_imbalance", 99.0) <= 1.5,
+        # the actual timeout-bound win: with the giant round split
+        # mid-flight, the makespan (max rank wall) must beat the
+        # unsplittable run outright — rank walls include the thief's
+        # serve/wait phase, so the imbalance gate above alone would
+        # be satisfiable by waiting
+        "makespan_improved": max(
+            s["wall_s"] for s in moved["shards"]) < max(
+            s["wall_s"] for s in plain["shards"]),
+        "sigkill_resume": bool(
+            crash_gates.get("ckpt_written")
+            and crash_gates.get("done_rows")
+            and crash_gates.get("report_identical")),
+    }
+    return {
+        "wall_s": wall,
+        "ckpt_on_walls": [s["wall_s"] for s in moved["shards"]],
+        "ckpt_off_walls": [s["wall_s"] for s in plain["shards"]],
+        "wall_imbalance": {"ckpt_on": moved.get("wall_imbalance"),
+                           "ckpt_off": plain.get("wall_imbalance")},
+        "midflight_steals": moved.get("midflight_steals", 0),
+        "states_migrated": moved.get("states_migrated", 0),
+        "lanes_exported": sum(
+            s["solver"].get("lanes_exported", 0)
+            for s in moved["shards"]),
+        "lanes_imported": sum(
+            s["solver"].get("lanes_imported", 0)
+            for s in moved["shards"]),
+        "resume_rounds": sum(
+            s["solver"].get("resume_rounds", 0)
+            for s in moved["shards"]),
+        "crash": crash_gates,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Seven stages:
+    run-wide verdict cache — NO full corpus sweep. Eleven stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -1860,6 +2139,15 @@ def bench_smoke():
        with nonzero hinted_solves, and issue identity with
        MTPU_TAINT on vs off on both the lane and host paths. Any
        miss exits 1;
+    11. the lane-plane checkpointing gate (_smoke_ckpt,
+       docs/checkpoint.md): a rigged two-rank single-giant-round long
+       pole where the finished-state yield provably cannot help —
+       mid-flight wave splitting balances the ranks (identity ckpt
+       on/off, nonzero midflight_steals, max wall <= 1.5x mean,
+       timeout-bound per the single-CPU constraint) — plus a SIGKILL-
+       mid-round standalone run whose restart resumes to an identical
+       report.
+
     10. the observability gate (_smoke_trace,
        docs/observability.md): a traced rigged run gating spans
        recorded across >= 4 subsystems, a valid Chrome trace-event
@@ -2066,6 +2354,20 @@ def bench_smoke():
     else:
         out["trace"] = {"skipped": True, "ok": True}
 
+    # stage 11: the lane-plane checkpointing gate (docs/checkpoint.md):
+    # mid-flight wave splitting on a rigged two-rank single-giant-round
+    # long pole (report identity ckpt on/off, nonzero midflight steals,
+    # max rank wall <= 1.5x mean) plus SIGKILL-a-rank-mid-round ->
+    # restart -> identical report; skippable via MTPU_SMOKE_CKPT=0
+    if os.environ.get("MTPU_SMOKE_CKPT", "1") != "0":
+        try:
+            out["ckpt"] = _smoke_ckpt()
+        except Exception as e:
+            out["ckpt"] = {"ok": False, "error": type(e).__name__,
+                           "detail": str(e)[:200]}
+    else:
+        out["ckpt"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -2103,7 +2405,12 @@ def bench_smoke():
           # the observability gate: multi-subsystem spans, valid
           # Chrome trace, flight recorder on induced fatal, off-path
           # wall parity with issue identity
-          and out["trace"].get("ok", False))
+          and out["trace"].get("ok", False)
+          # the checkpointing gate: a live single-giant-round wave
+          # provably splits mid-flight (report identity on/off,
+          # balanced rank walls) and a SIGKILLed rank's restart
+          # resumes to an identical report
+          and out["ckpt"].get("ok", False))
     return 0 if ok else 1
 
 

@@ -243,12 +243,13 @@ class SymExecWrapper:
             )
 
         # transaction-boundary checkpointing (support/checkpoint.py):
-        # install the per-round sink, and divert to resume_exec when a
-        # loadable snapshot exists
+        # install the per-round sink, arm the SIGTERM/fatal live dump,
+        # and divert to resume_exec when a loadable snapshot exists
         resumed = False
         if args.checkpoint_file:
             from ..support.checkpoint import (
-                code_identity, load_checkpoint, save_checkpoint,
+                arm_live_dump, code_identity, load_checkpoint,
+                save_checkpoint,
             )
 
             path = args.checkpoint_file
@@ -256,19 +257,69 @@ class SymExecWrapper:
             # sharing one checkpoint file must not resume each other
             code_id = code_identity(contract)
 
+            def _save_ckpt_verdicts(open_states):
+                # verdict-bank sidecar beside the snapshot: a resumed
+                # run replays the proofs this run already settled, so
+                # its screens start warm instead of re-proving
+                # (docs/checkpoint.md; same format migration batches
+                # ship — best-effort, never blocks the snapshot)
+                try:
+                    from ..parallel.migrate import MigrationBus
+                    from ..smt.solver import verdicts as verdict_mod
+                    from ..support.checkpoint import (
+                        save_verdict_sidecar,
+                    )
+
+                    vc = verdict_mod.cache()
+                    if vc is None:
+                        return
+                    entries = MigrationBus._entries_for(
+                        list(open_states), vc)
+                    if entries:
+                        save_verdict_sidecar(str(path) + ".verdicts",
+                                             entries)
+                except Exception as e:
+                    log.debug("checkpoint verdict sidecar failed: %s",
+                              e)
+
             def _sink(next_round, open_states, addr):
                 save_checkpoint(
                     path, next_round, open_states,
                     addr.value if isinstance(addr, BitVec) else addr,
                     code_id)
+                _save_ckpt_verdicts(open_states)
 
             self.laser.checkpoint_sink = _sink
+            # a rank dying with this analysis mid-round leaves a LIVE
+            # checkpoint (open states + the in-flight plane) in
+            # flightrec/ and refreshes `path` — the contract re-enters
+            # the queue as resumable work (docs/checkpoint.md)
+            arm_live_dump(self.laser, path, code_id)
             payload = load_checkpoint(path, code_id)
             if payload is not None:
+                # warm the verdict/fact banks from the sidecar the
+                # sink (or live dump) wrote beside the snapshot
+                try:
+                    from ..smt.solver import verdicts as verdict_mod
+                    from ..support.checkpoint import (
+                        load_verdict_sidecar,
+                    )
+
+                    vc = verdict_mod.cache()
+                    entries = load_verdict_sidecar(
+                        str(path) + ".verdicts") if vc is not None else []
+                    if entries:
+                        replayed = vc.import_entries(entries)
+                        log.info("checkpoint resume: replayed %d "
+                                 "banked verdicts", replayed)
+                except Exception as e:
+                    log.debug("checkpoint verdict replay failed: %s",
+                              e)
                 self.laser.resume_exec(
                     payload["open_states"],
                     payload["target_address"],
                     payload["round"],
+                    inflight=payload.get("inflight"),
                 )
                 resumed = True
 

@@ -275,6 +275,14 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
                         help="Checkpoint the analysis after each "
                              "symbolic transaction round; if FILE "
                              "already holds a snapshot, resume from it")
+    options.add_argument("--resume", metavar="DIR", default=None,
+                        help="Resume a crashed/preempted run from the "
+                             "live checkpoint a previous run left "
+                             "under DIR (flightrec/resume_rank*.ckpt "
+                             "from a SIGTERM/fatal dump, or "
+                             "resume.ckpt) and keep checkpointing "
+                             "there — docs/checkpoint.md. Overridden "
+                             "by an explicit --checkpoint FILE")
     options.add_argument("--trace-out", metavar="FILE", default=None,
                         help="Record structured telemetry spans "
                              "(implies MTPU_TRACE=1) and write a "

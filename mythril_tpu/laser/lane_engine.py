@@ -706,7 +706,7 @@ def _merge_fingerprint(st: SymLaneState, prov_pairs):
     (docs/lane_merge.md): the lane-dedup extension of the _dedup_canon/
     _unique_table record-dedup machinery. Folds everything a lane's
     future execution (and its materialization) can read — pc, depth,
-    fork group, fentry, gas interval, the live stack (canonical sids +
+    fork group, fentry, gas limit, the live stack (canonical sids +
     concrete limbs), memory bytes + overlay records, the storage slot
     table with write-ORDER ranks (absolute s_wstep values differ between
     gas-balanced rejoin arms and must not block a merge), and the
@@ -720,7 +720,8 @@ def _merge_fingerprint(st: SymLaneState, prov_pairs):
     witness path). Equal fingerprints + equal host context
     (template/swrites/promos) define an exact-frontier twin group.
 
-    Returns (N, 2) uint32."""
+    Returns (N, 4) uint32: the two hash columns plus the raw gas
+    interval (min, max) for host-side grouping / widening."""
     n = st.pc.shape[0]
     d_recs = st.dlog_op.shape[1]
     dense = jnp.full((n * d_recs,), np.iinfo(np.int32).min, jnp.int32)
@@ -759,10 +760,15 @@ def _merge_fingerprint(st: SymLaneState, prov_pairs):
         h2 = h2 ^ (h2 >> 15)
         return h1, h2
 
+    # gas interval deliberately NOT folded (since the gas-widening
+    # merge, docs/lane_merge.md): the host groups on it exactly when
+    # widening is off, and widens the survivor's ctx offsets to cover
+    # every arm when on — so uneven-gas rejoin arms fingerprint equal.
+    # gas_limit stays in the hash: widening covers usage, not budget.
     for scalar in (st.pc, st.sp, st.depth, st.group, st.fentry,
                    st.msize, st.mlog_count, st.scount, st.s_mode,
                    st.sbase, st.cd_size, st.cd_sym, st.cd_size_sid,
-                   st.min_gas, st.max_gas, st.gas_limit):
+                   st.gas_limit):
         h1, h2 = fold(h1, h2, scalar)
 
     depth_cap = st.stack.shape[1]
@@ -814,7 +820,8 @@ def _merge_fingerprint(st: SymLaneState, prov_pairs):
                    dtype=jnp.int32)
     h1, h2 = fold(h1, h2, jnp.where(written, rank, -1))
 
-    return jnp.stack([h1, h2], axis=1)
+    return jnp.stack([h1, h2, st.min_gas.astype(jnp.uint32),
+                      st.max_gas.astype(jnp.uint32)], axis=1)
 
 
 #: fast-retire row budget and column floors (stack slots, memory bytes,
@@ -1582,6 +1589,15 @@ class LaneEngine:
         self._resume_flag = jnp.asarray(
             1 if self.resume_on else 0, jnp.int32)
         self.last_run_stats: Optional[dict] = None
+        #: mid-flight wave export client (docs/checkpoint.md; set by
+        #: svm from the migration bus): polled at every window
+        #: boundary — `want(live)` lanes retire through the escalation
+        #: gather, materialize, and hand to `deliver(states)` as an
+        #: in-flight migration batch. None = seam off (the default).
+        self.export_client = None
+        #: live lane ctxs of an explore in progress (SIGTERM dump
+        #: path: support/checkpoint.snapshot_live_states)
+        self._explore_ctxs = None
 
     def _full_bucket(self) -> int:
         """Full-width seed bucket for backlog drains, kept strictly
@@ -2759,15 +2775,23 @@ class LaneEngine:
         except Exception as e:  # a screen, never an error path
             log.debug("merge fingerprint failed: %s", e)
             return
-        merged = subsumed = 0
+        # gas-widening merge (MTPU_MERGE_GASWIDEN, default on): with
+        # widening OFF the gas interval joins the exact twin key (the
+        # historical behavior — uneven-gas arms never merge); with it
+        # ON, arms group gas-blind and the survivor's ctx gas offsets
+        # widen to cover every dropped arm, a sound interval
+        # over-approximation (docs/lane_merge.md)
+        gas_widen = merge_mod.gas_widen_enabled()
+        merged = subsumed = widened = 0
         for key, lanes in pre.items():
             if len(lanes) < 2:
                 continue
             twins: Dict[tuple, List[int]] = {}
             for lane in lanes:
-                twins.setdefault(
-                    (int(fp[lane, 0]), int(fp[lane, 1])), []
-                ).append(lane)
+                tkey = (int(fp[lane, 0]), int(fp[lane, 1]))
+                if not gas_widen:
+                    tkey += (int(fp[lane, 2]), int(fp[lane, 3]))
+                twins.setdefault(tkey, []).append(lane)
             for group in twins.values():
                 if len(group) < 2:
                     continue
@@ -2790,6 +2814,21 @@ class LaneEngine:
                         sc[:plan.prefix_len]
                         + [(stamp, c)
                            for c in plan.new_conds[plan.prefix_len:]])
+                if gas_widen:
+                    # the survivor now represents every dropped arm:
+                    # widen its host gas offsets so the effective
+                    # interval (materialize/_DrainSite add gas0_* to
+                    # the device values) covers the group's hull
+                    members = [survivor] + [group[mi]
+                                            for mi in plan.dropped]
+                    dmin = min(int(fp[m, 2]) for m in members) \
+                        - int(fp[survivor, 2])
+                    dmax = max(int(fp[m, 3]) for m in members) \
+                        - int(fp[survivor, 3])
+                    if dmin or dmax:
+                        ctxs[survivor].gas0_min += dmin
+                        ctxs[survivor].gas0_max += dmax
+                        widened += len(plan.dropped)
                 for mi, reason in plan.dropped.items():
                     kill.append(group[mi])
                     if reason == "merged":
@@ -2800,16 +2839,112 @@ class LaneEngine:
             self.stats["lanes_merged"] += merged
             self.stats["lanes_subsumed"] += subsumed
             self.stats["merge_rounds"] += 1
+            self.stats["gas_widened"] = (
+                self.stats.get("gas_widened", 0) + widened)
             from ..smt.solver.solver_statistics import SolverStatistics
 
             SolverStatistics().bump(
                 lanes_merged=merged, lanes_subsumed=subsumed,
-                merge_rounds=1)
+                merge_rounds=1, gas_widened_lanes=widened)
             merge_mod.note_retired(merged + subsumed)
             trace.event("merge.window", merged=merged,
                         subsumed=subsumed)
             log.info("lane merge: %d merged, %d subsumed at window "
                      "boundary", merged, subsumed)
+
+    def live_seed_states(self) -> List[GlobalState]:
+        """Host-only snapshot of every live lane as (seed template +
+        accumulated path conditions) — the lane's state at the window
+        boundary where it was seeded, restricted to its recorded
+        branch. Safe from a signal handler (no device access), so the
+        SIGTERM/fatal live dump can capture lanes mid-window
+        (support/checkpoint.snapshot_live_states); the device progress
+        since the seed re-executes on resume, and issue dedup absorbs
+        any re-detection. Empty when no explore is running."""
+        ctxs = self._explore_ctxs
+        if not ctxs:
+            return []
+        out = []
+        for ctx in list(ctxs):
+            if ctx is None:
+                continue
+            try:
+                gs = copy(ctx.template)
+                for _step, cond in list(ctx.conds):
+                    gs.world_state.constraints.append(cond)
+                out.append(gs)
+            except Exception:
+                continue  # best-effort: the lane re-runs from the
+                #           round checkpoint instead
+        return out
+
+    def _window_export(self, st, status, ctxs, dead_set, kill,
+                       resumes, steps, free, results,
+                       retire_floors, padded_idx):
+        """Mid-flight wave export at the window boundary
+        (docs/checkpoint.md): when the export client asks for n lanes,
+        the TAIL of the live set retires through the escalation gather
+        and materializes into ordinary mid-path GlobalStates — the
+        complete per-lane plane (pc, depth, call frame, stack, memory,
+        storage slots, gas interval, constraints, pending promotions)
+        — which `deliver` ships as an in-flight migration batch. The
+        exported lanes are DEAD on device the moment the gather runs
+        (same protocol as the escalation retire), so a shipped lane
+        never executes another step: kill-then-import. A declined
+        delivery parks the states locally instead — work can move,
+        but never be lost. Runs AFTER the merge pass so a lane about
+        to collapse is never shipped."""
+        client = self.export_client
+        excluded = dead_set | set(kill) | {r[0] for r in resumes}
+        live = [lane for lane in range(self.n_lanes)
+                if (ctxs[lane] is not None and lane not in excluded
+                    and status[lane] == Status.RUNNING)]
+        if len(live) < 2:
+            return st
+        try:
+            want = int(client.want(len(live)))
+        except Exception:
+            want = 0
+        want = min(want, len(live) - 1)
+        if want < 1:
+            return st
+        sel = live[len(live) - want:]
+        try:
+            floors = retire_floors(sel)
+            with _prof("ckpt_export"), \
+                    trace.span("ckpt.export", lanes=len(sel)):
+                st, rows = _retire_rows(
+                    st, jnp.asarray(padded_idx(sel)), *floors)
+                rows_host = _unpack_rows(jax.device_get(rows), *floors)
+                exported = [self.materialize(rows_host, row, ctxs[lane])
+                            for row, lane in enumerate(sel)]
+        except Exception as e:  # a seam, never an error path
+            log.warning("mid-flight lane export failed (%s); lanes "
+                        "stay local", e)
+            return st
+        # the gather marked the rows DEAD on device: recycle the slots
+        # now, exactly like the escalation retire
+        for lane in sel:
+            self.stats["device_steps"] += int(steps[lane])
+            ctxs[lane] = None
+            free.append(lane)
+        status[np.asarray(sel, np.int32)] = DEAD
+        delivered = False
+        try:
+            delivered = bool(client.deliver(exported))
+        except Exception as e:
+            log.debug("export delivery failed: %s", e)
+        if delivered:
+            self.stats["exported"] = (
+                self.stats.get("exported", 0) + len(sel))
+            log.info("mid-flight export: %d live lanes shipped at the "
+                     "window boundary", len(sel))
+        else:
+            # undeliverable (no thief claimed / save failed): the
+            # states are ordinary parked mid-path states — they
+            # continue locally through the spill/refill path
+            results.extend(exported)
+        return st
 
     # -- top-level loop ------------------------------------------------------
 
@@ -2863,6 +2998,9 @@ class LaneEngine:
             visited = jnp.zeros(cc.packed.shape[0], bool)
         st = self._acquire_state()
         ctxs: List[Optional[LaneCtx]] = [None] * self.n_lanes
+        # expose the live ctx table for the SIGTERM live dump
+        # (live_seed_states); cleared in the finally below
+        self._explore_ctxs = ctxs
         queue = deque(entry_states)
         free = list(range(self.n_lanes - 1, -1, -1))
         results: List[GlobalState] = []
@@ -3308,6 +3446,16 @@ class LaneEngine:
                 # another step
                 self._window_merge(st, status, ctxs, dead_set, kill,
                                    counts_h, resumes)
+                # mid-flight wave export (MTPU_CKPT,
+                # docs/checkpoint.md): a work-stealing client can take
+                # the tail of the live wave at this boundary — the
+                # lanes retire into complete mid-path GlobalStates and
+                # ship; their slots free for the next dispatch
+                if self.export_client is not None:
+                    st = self._window_export(
+                        st, status, ctxs, dead_set, kill, resumes,
+                        steps, free, results, _retire_floors,
+                        _padded_idx)
                 # collect the NEXT overlapped screen batch: lanes that
                 # gained path conditions this window and are still
                 # running (their descendants subset-kill through the
@@ -3345,6 +3493,7 @@ class LaneEngine:
             # the last window has no successor dispatch to hide behind
             _flush_pending()
         finally:
+            self._explore_ctxs = None
             trace.end("lane.explore",
                       windows=self.stats["windows"]
                       - stats0.get("windows", 0))

@@ -60,7 +60,7 @@ corpus (tests/test_lane_merge.py, bench.py --smoke stage 7).
 Counters (SolverStatistics → batch_counters → both telemetry plugins,
 bench detail blocks, shard reports, the bench_corpus aggregate):
 ``lanes_merged``, ``lanes_subsumed``, ``merge_rounds``,
-``or_terms_built``.  See docs/lane_merge.md.
+``or_terms_built``, ``gas_widened_lanes``.  See docs/lane_merge.md.
 """
 
 import logging
@@ -90,6 +90,18 @@ def subsume_enabled() -> bool:
     (tid-superset subsumption is pure set algebra and always on with
     the pass)."""
     return os.environ.get("MTPU_MERGE_SUBSUME", "1") != "0"
+
+
+def gas_widen_enabled() -> bool:
+    """Gas-widening sub-gate (MTPU_MERGE_GASWIDEN, default on):
+    uneven-gas rejoin arms fingerprint equal, and the survivor's
+    ctx-level gas offsets widen to the group's interval hull — a sound
+    over-approximation of the per-path gas accounting, which was
+    already an interval. Off, the gas interval re-joins the exact twin
+    key and only gas-identical arms merge (the pre-widening
+    behavior)."""
+    return enabled() and \
+        os.environ.get("MTPU_MERGE_GASWIDEN", "1") != "0"
 
 
 def propagate_abstractions_enabled() -> bool:

@@ -96,6 +96,14 @@ class LaserEVM:
         # round, and the per-round snapshot callback
         self.start_round: int = 0
         self.checkpoint_sink: Optional[Callable] = None
+        # live lane-plane resume (docs/checkpoint.md): in-flight
+        # GlobalStates restored from a checkpoint finish their
+        # interrupted round before the round loop continues; the round
+        # context below is what a SIGTERM/fatal live dump stamps its
+        # checkpoint with (next unrun round, tx count, address)
+        self._resume_inflight: Optional[List[GlobalState]] = None
+        self._ckpt_round_ctx: Optional[tuple] = None
+        self._ckpt_current_state: Optional[GlobalState] = None
         # static pre-analysis round context (docs/static_pass.md):
         # True while the CURRENT message-call round is the run's last —
         # its open states seed nothing, so a statically-dead state may
@@ -210,18 +218,24 @@ class LaserEVM:
         for hook in self._stop_sym_exec_hooks:
             hook()
 
-    def resume_exec(self, open_states, address, start_round: int
-                    ) -> None:
+    def resume_exec(self, open_states, address, start_round: int,
+                    inflight=None) -> None:
         """Continue a checkpointed analysis: restored open states, the
         original target address, and the first UNRUN transaction round
-        (support/checkpoint.py owns the snapshot format)."""
-        log.info("Resuming symbolic execution at round %d", start_round)
+        (support/checkpoint.py owns the snapshot format). ``inflight``
+        is the live lane plane of a mid-round checkpoint — states
+        mid-way through round ``start_round - 1`` that finish that
+        round first (docs/checkpoint.md)."""
+        log.info("Resuming symbolic execution at round %d (%d "
+                 "in-flight states)", start_round,
+                 len(inflight or ()))
         for hook in self._start_sym_exec_hooks:
             hook()
         time_handler.start_execution(self.execution_timeout)
         self.time = datetime.now()
         self.open_states = list(open_states)
         self.start_round = start_round
+        self._resume_inflight = list(inflight) if inflight else None
         if isinstance(address, int):
             address = symbol_factory.BitVecVal(address, 256)
         self.execute_transactions(address)
@@ -243,6 +257,12 @@ class LaserEVM:
         `checkpoint_sink` callback fires after each completed round with
         (next round index, open states, concrete target address)."""
         self.time = datetime.now()
+        # live-plane resume (docs/checkpoint.md): in-flight states of
+        # round start_round-1 finish that round FIRST — their end
+        # states join open_states before the loop re-seeds
+        if self._resume_inflight:
+            inflight, self._resume_inflight = self._resume_inflight, None
+            self._finish_inflight_round(address, inflight)
         for i in range(self.start_round, self.transaction_count):
             if len(self.open_states) == 0:
                 break
@@ -297,7 +317,10 @@ class LaserEVM:
             # round context for the migration bus's MID-ROUND yield
             # (parallel/migrate.py): states finishing round i await
             # round i+1, so a slice exported while round i still runs
-            # resumes at i+1 on the thief
+            # resumes at i+1 on the thief. The same tuple stamps a
+            # SIGTERM/fatal live dump (support/checkpoint.py).
+            self._ckpt_round_ctx = (i + 1, self.transaction_count,
+                                    address)
             bus = getattr(args, "migration_bus", None)
             if bus is not None:
                 bus.begin_round(i + 1, self.transaction_count, address)
@@ -340,7 +363,44 @@ class LaserEVM:
             trace.end("svm.round",
                       open_states=len(self.open_states))
         self.start_round = 0  # a later sym_exec must not skip rounds
+        self._ckpt_round_ctx = None
         self.executed_transactions = True
+
+    def _finish_inflight_round(self, address, inflight) -> None:
+        """Finish an interrupted transaction round from its restored
+        in-flight lane plane (docs/checkpoint.md): the states enter
+        the worklist mid-transaction exactly where the checkpoint cut
+        them — the lane sweep re-materializes device-seedable ones
+        into its own plane at the next window boundary, the host loop
+        continues the rest — and their end states join open_states for
+        the normal loop at ``start_round``. Hook pairs fire like any
+        round's, so plugin bookkeeping stays balanced."""
+        i = max(self.start_round - 1, 0)
+        log.info("finishing interrupted round %d from %d in-flight "
+                 "states", i, len(inflight))
+        trace.begin("ckpt.resume", round=i, inflight=len(inflight))
+        self._static_final_tx = i + 1 >= self.transaction_count
+        self._ckpt_round_ctx = (i + 1, self.transaction_count, address)
+        bus = getattr(args, "migration_bus", None)
+        if bus is not None:
+            bus.begin_round(i + 1, self.transaction_count, address)
+        for hook in self._start_sym_trans_hooks:
+            hook()
+        self.work_list.extend(inflight)
+        self.exec()
+        for hook in self._stop_sym_trans_hooks:
+            hook()
+        if bus is not None:
+            bus.on_round_end(self, i + 1, self.transaction_count,
+                             address)
+        try:
+            from ..smt.solver.solver_statistics import SolverStatistics
+
+            SolverStatistics().bump(resume_rounds=1,
+                                    lanes_imported=len(inflight))
+        except Exception:  # telemetry only
+            pass
+        trace.end("ckpt.resume", open_states=len(self.open_states))
 
     def _static_tx_prune_screen(self, address) -> None:
         """Pre-round static independence screen (docs/static_pass.md,
@@ -754,6 +814,18 @@ class LaserEVM:
                 engine.static_final_tx = static_final
                 engine.static_jump_patch_ok = static_patch_ok
                 engine.static_module_names = static_module_names
+                # mid-flight wave export (docs/checkpoint.md): the
+                # migration bus can take the tail of a live device
+                # wave at any window boundary; None when no bus or
+                # live checkpointing is off (MTPU_CKPT=0)
+                engine.export_client = None
+                bus_mig = getattr(args, "migration_bus", None)
+                if bus_mig is not None:
+                    try:
+                        engine.export_client = \
+                            bus_mig.lane_export_client()
+                    except Exception:
+                        engine.export_client = None
                 parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(
@@ -822,6 +894,14 @@ class LaserEVM:
         midround_tick = 0
         try:
             for global_state in self.strategy:
+                # live-dump visibility (support/checkpoint.py): the
+                # state being executed was already popped from the
+                # worklist — a SIGTERM snapshot taken mid-step must
+                # include it or its whole subtree is lost. Cleared
+                # once its successors are safely in the worklist
+                # (re-executing one step on resume is sound; issue
+                # dedup absorbs it).
+                self._ckpt_current_state = global_state
                 if create and self._check_create_termination():
                     log.debug("Hit create timeout, returning.")
                     return final_states + [global_state] \
@@ -863,6 +943,7 @@ class LaserEVM:
                     self.work_list += new_states
                 elif track_gas:
                     final_states.append(global_state)
+                self._ckpt_current_state = None
                 self.total_states += len(new_states)
                 if bus is not None:
                     midround_tick += 1
@@ -1318,14 +1399,18 @@ class LaserEVM:
         new_node.constraints = state.world_state.constraints
         if self.requires_statespace:
             self.nodes[new_node.uid] = new_node
-            self.edges.append(
-                Edge(
-                    old_node.uid,
-                    new_node.uid,
-                    edge_type=edge_type,
-                    condition=condition,
+            # a checkpoint-restored in-flight state re-enters with its
+            # node dropped (support/checkpoint.py persistent-id): its
+            # subtree re-roots here without an incoming edge
+            if old_node is not None:
+                self.edges.append(
+                    Edge(
+                        old_node.uid,
+                        new_node.uid,
+                        edge_type=edge_type,
+                        condition=condition,
+                    )
                 )
-            )
 
         if edge_type == JumpType.RETURN:
             new_node.flags |= NodeFlags.CALL_RETURN.value

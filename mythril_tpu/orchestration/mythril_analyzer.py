@@ -31,6 +31,24 @@ def reset_analysis_state() -> None:
     keccak_function_manager.reset()
 
 
+def _resume_checkpoint_path(resume_dir: str) -> str:
+    """The checkpoint file `--resume DIR` binds to: the newest
+    flight-recorder live dump (flightrec/resume_rank<r>.ckpt — what a
+    SIGTERM'd or crashed rank leaves behind) when one exists, else
+    DIR/resume.ckpt (also the path future round snapshots land on, so
+    an interrupted resumed run stays resumable)."""
+    import glob
+    import os
+
+    candidates = sorted(
+        glob.glob(os.path.join(str(resume_dir), "flightrec",
+                               "resume_rank*.ckpt")),
+        key=lambda p: os.path.getmtime(p), reverse=True)
+    if candidates:
+        return candidates[0]
+    return os.path.join(str(resume_dir), "resume.ckpt")
+
+
 class MythrilAnalyzer:
     def __init__(
         self,
@@ -75,6 +93,19 @@ class MythrilAnalyzer:
         args.tpu_lanes = getattr(cmd_args, "tpu_lanes", args.tpu_lanes)
         args.tpu_mesh = getattr(cmd_args, "tpu_mesh", args.tpu_mesh)
         args.checkpoint_file = getattr(cmd_args, "checkpoint", None)
+        # --resume DIR (docs/checkpoint.md): continue from the live
+        # checkpoint a crashed/preempted run left under DIR — the
+        # flight recorder's SIGTERM/fatal resume_rank*.ckpt when
+        # present, else DIR/resume.ckpt — and keep checkpointing
+        # there. An explicit --checkpoint FILE wins.
+        resume_dir = getattr(cmd_args, "resume", None)
+        if resume_dir and not args.checkpoint_file:
+            args.checkpoint_file = _resume_checkpoint_path(resume_dir)
+            from ..support import telemetry
+
+            # re-arm the flight recorder against the same dir so a
+            # second preemption refreshes the same artifact set
+            telemetry.configure(out_dir=resume_dir)
         args.migration_bus = getattr(cmd_args, "migration_bus", None)
         # run-wide observability (docs/observability.md): --trace-out
         # arms span tracing and the at-exit Chrome trace export

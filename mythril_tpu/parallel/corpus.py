@@ -147,9 +147,28 @@ def default_analyze(path: str, timeout: int = 60,
         from .cost_model import warm_path_history
 
         warm_path_history(contract.disassembly, Path(path).name, stats)
+    # per-contract live checkpointing (MTPU_CKPT, docs/checkpoint.md):
+    # round snapshots (and a SIGTERM/fatal live dump) land under
+    # --out-dir/ckpt/<name>.ckpt, so a killed rank's restart resumes
+    # the interrupted contract instead of re-running it from zero.
+    # Removed again after a completed analysis — a finished contract
+    # must never "resume" into a no-op on the next corpus run.
+    ckpt_path = None
+    try:
+        from ..support.checkpoint import live_enabled
+        from ..support.telemetry import flightrec
+
+        out_root = flightrec.configured_dir()
+        if out_root and live_enabled():
+            ckpt_dir = Path(out_root) / "ckpt"
+            ckpt_dir.mkdir(parents=True, exist_ok=True)
+            ckpt_path = str(ckpt_dir / (Path(path).name + ".ckpt"))
+    except Exception:
+        ckpt_path = None
     cmd_args = make_cmd_args(execution_timeout=timeout,
                              tpu_lanes=tpu_lanes,
-                             migration_bus=bus)
+                             migration_bus=bus,
+                             checkpoint=ckpt_path)
     analyzer = MythrilAnalyzer(
         disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
         address=address,
@@ -157,11 +176,19 @@ def default_analyze(path: str, timeout: int = 60,
     migrated = 0
     if bus is not None:
         bus.begin_contract(path, contract)
-    report = analyzer.fire_lasers(modules=None, transaction_count=2)
+    tx_count = int(os.environ.get("MTPU_CORPUS_TX", "2") or 2)
+    report = analyzer.fire_lasers(modules=None,
+                                  transaction_count=tx_count)
     if bus is not None:
         # merge issues from batches other ranks analyzed for us —
         # append_issue dedups exactly as the unsplit run would
         migrated = bus.finalize_contract(report)
+    if ckpt_path:
+        for leftover in (ckpt_path, ckpt_path + ".verdicts"):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
     issues = report.sorted_issues()
     out = {
         "contract": Path(path).name,
@@ -255,7 +282,64 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     results = []
     t0 = time.perf_counter()
 
+    # crash-restart bookkeeping (docs/checkpoint.md): each completed
+    # contract leaves an atomic result row under --out-dir/done/; a
+    # restarted run (same --out-dir, after SIGKILL/SIGTERM/power loss)
+    # adopts those rows and re-runs only the interrupted contract —
+    # which then RESUMES from its per-contract checkpoint (see
+    # default_analyze) instead of starting over. MTPU_CKPT=0 disables
+    # both halves.
+    from ..support.checkpoint import live_enabled as _ckpt_on
+
+    done_dir = out / "done"
+    done_rows = {}
+    if _ckpt_on():
+        done_dir.mkdir(exist_ok=True)
+        report_file = out / "corpus_report.json"
+        if report_file.exists():
+            # the previous run over this --out-dir COMPLETED: its
+            # done-rows and per-contract checkpoints are leftovers,
+            # not resumable state — a fresh run must re-analyze, not
+            # adopt (the stats.json LPT warm start is separate and
+            # survives). Removing the report is what makes a crash of
+            # THIS run distinguishable from a completed one.
+            try:
+                report_file.unlink()
+            except OSError:
+                pass
+            for stale in list(done_dir.glob("*.json")) + list(
+                    (out / "ckpt").glob("*")):
+                try:
+                    stale.unlink()
+                except OSError:
+                    pass
+        for row_file in done_dir.glob("*.json"):
+            try:
+                row = json.loads(row_file.read_text())
+                done_rows[row["path"]] = row
+            except Exception:
+                continue
+
+    def _mark_done(r):
+        if not _ckpt_on():
+            return
+        try:
+            from hashlib import sha256
+
+            name = sha256(r["path"].encode()).hexdigest()[:24]
+            tmp = done_dir / (name + ".tmp")
+            tmp.write_text(json.dumps(r))
+            os.replace(tmp, done_dir / (name + ".json"))
+        except Exception as e:  # bookkeeping only
+            log.debug("done-row write failed: %s", e)
+
     def _run_one(path, stolen_from=None):
+        prior = done_rows.get(str(path))
+        if prior is not None:
+            log.info("restart: %s already completed in a previous "
+                     "run; adopting its result", path)
+            results.append(prior)
+            return
         t_c = time.perf_counter()
         try:
             r = analyze(path)
@@ -268,6 +352,7 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
         if stolen_from is not None:
             r["stolen_from"] = stolen_from
         results.append(r)
+        _mark_done(r)
 
     for path in shard:
         if client is not None and steal and not _claim(client, path,
@@ -381,7 +466,7 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     merged["wall_imbalance"] = round(max(walls) / mean, 3) \
         if mean > 0 else 1.0
     for key in ("states_migrated", "batches_out", "batches_in",
-                "midround_exports"):
+                "midround_exports", "midflight_steals"):
         merged[key] = sum(s["migration"].get(key, 0)
                           for s in merged["shards"])
     # corpus-wide metrics aggregate: per-rank registry states merge

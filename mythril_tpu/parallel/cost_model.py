@@ -187,6 +187,20 @@ def splittable_set(paths: Sequence[str], costs: Dict[str, float],
     return {p for p in paths if costs[p] > fair}
 
 
+def midwave_share(live: int, thieves: int, keep_min: int = 1) -> int:
+    """Per-thief slice of a live IN-FLIGHT wave (docs/checkpoint.md:
+    mid-flight wave splitting over the migration bus): an equal split
+    across the victim and k thieves — the same proportional policy the
+    finished-state export uses — floored so the victim always keeps at
+    least ``keep_min`` states. 0 when the wave is too small to shed.
+    One place for the policy so the svm worklist export and the lane
+    engine's window-boundary export cannot drift."""
+    if live <= keep_min or thieves < 1:
+        return 0
+    share = live // (thieves + 1)
+    return max(0, min(share, live - keep_min))
+
+
 def make_shards(paths: Sequence[str], num_processes: int,
                 stats: Optional[Dict[str, dict]] = None,
                 ) -> Tuple[List[List[str]], Set[str]]:

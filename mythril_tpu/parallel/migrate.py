@@ -120,6 +120,15 @@ SHIP_VERDICTS = os.environ.get("MTPU_SHIP_VERDICTS", "1") != "0"
 #: analysis (parallel/cost_model.py handles the prior-seeded half)
 SPLIT_EAGER_FORKS = int(os.environ.get("MTPU_SPLIT_EAGER_FORKS", "128"))
 
+#: mid-flight wave splitting (docs/checkpoint.md): minimum live
+#: in-flight states before a worklist/lane-plane slice is worth an
+#: offer, and the monotonic cooldown between in-flight exports (a
+#: thief's request file stays fresh while it chews a batch — without
+#: the cooldown a victim could starve itself feeding one slow thief)
+MIDFLIGHT_MIN_LIVE = int(os.environ.get("MTPU_MIDFLIGHT_MIN", "8"))
+MIDFLIGHT_COOLDOWN_S = float(
+    os.environ.get("MTPU_MIDFLIGHT_COOLDOWN", "2.0"))
+
 
 def code_identity(contract) -> str:
     """The checkpoint code binding (support/checkpoint.py owns it)."""
@@ -168,8 +177,13 @@ class MigrationBus:
             "batches_out": 0,       # offers published (victim)
             "batches_in": 0,        # migrated batches served (thief)
             "midround_exports": 0,  # export waves fired mid-round
+            "midflight_steals": 0,  # offers that split a LIVE wave
+            #                         (in-flight states, not finished
+            #                         ones — docs/checkpoint.md)
             "steal_latency_s": 0.0,  # request -> first claimed batch
         }
+        #: monotonic stamp of the last in-flight export (cooldown)
+        self._midflight_last = 0.0
         self._req_cache: Optional[tuple] = None
         self._victim_hb: Optional[_Heartbeat] = None
         #: monotonic change-observation clock for every peer
@@ -240,7 +254,11 @@ class MigrationBus:
         """svm hook, fired every `yield_every` processed states: open
         states that already FINISHED the current round (accumulating in
         laser.open_states while the round's worklist still executes)
-        migrate to idle ranks without waiting for the boundary."""
+        migrate to idle ranks without waiting for the boundary. When
+        the finished wave cannot shed — a single giant round with few
+        completions, or the run's FINAL round (no rounds left for its
+        open states) — the live worklist itself splits instead
+        (midflight_yield, docs/checkpoint.md)."""
         ctx = self._round
         if ctx is None:
             return
@@ -249,13 +267,71 @@ class MigrationBus:
             self._split_eager = True  # first-round fork count refines
             #                           the prior-seeded cost estimate
         next_round, tx_count, address = ctx
-        if next_round >= tx_count or len(laser.open_states) < 2:
-            return
-        if not self._pending_requests():
-            return
-        if self._export_wave(laser.open_states, next_round, tx_count,
-                             address):
-            self.stats["midround_exports"] += 1
+        if next_round < tx_count and len(laser.open_states) >= 2 \
+                and self._pending_requests():
+            if self._export_wave(laser.open_states, next_round,
+                                 tx_count, address):
+                self.stats["midround_exports"] += 1
+                return
+        self.midflight_yield(laser)
+
+    def midflight_yield(self, laser) -> int:
+        """Split the LIVE in-flight wave (docs/checkpoint.md): tail
+        slices of the svm worklist — states mid-way through the
+        current round — export as inflight checkpoint batches that a
+        thief finishes with its own engine. This is what makes a
+        single giant round sheddable: the PR-3 bus could only move
+        states that had already finished a round. Gated by MTPU_CKPT;
+        returns offers published."""
+        from ..support.checkpoint import live_enabled
+
+        if not live_enabled() or self.current_contract is None:
+            return 0
+        ctx = self._round
+        if ctx is None:
+            return 0
+        if time.monotonic() - self._midflight_last \
+                < MIDFLIGHT_COOLDOWN_S:
+            return 0
+        work_list = getattr(laser, "work_list", None)
+        if work_list is None or len(work_list) < MIDFLIGHT_MIN_LIVE:
+            return 0
+        thieves = self._pending_requests()
+        if not thieves:
+            return 0
+        from .cost_model import midwave_share
+
+        next_round, tx_count, address = ctx
+        share = midwave_share(len(work_list), len(thieves))
+        if share < 1:
+            return 0
+        published = 0
+        for _ in range(len(thieves)):
+            if len(work_list) - share < 1:
+                break
+            chunk = work_list[len(work_list) - share:]
+            if not self._publish_offer(chunk, next_round, tx_count,
+                                       address, inflight=True):
+                break
+            # trim AFTER the successful save, like the finished-state
+            # export: an aborted offer leaves its states local
+            del work_list[len(work_list) - share:]
+            published += 1
+        if published:
+            self._midflight_last = time.monotonic()
+        return published
+
+    def lane_export_client(self):
+        """The lane engine's window-boundary export protocol
+        (lane_engine._window_export): `want(live)` sizes the slice,
+        `deliver(states)` publishes it as an inflight offer. None when
+        live checkpointing is off — the engine seam then never
+        engages."""
+        from ..support.checkpoint import live_enabled
+
+        if not live_enabled():
+            return None
+        return _LaneExportClient(self)
 
     def on_round_end(self, laser, next_round: int, tx_count: int,
                      address) -> None:
@@ -287,81 +363,119 @@ class MigrationBus:
         share = n // (k + 1)
         if share < 1:
             return 0
+        published = 0
+        for _ in range(k):
+            # always the current tail slice: the victim's own work
+            # continues from the head
+            chunk = states[len(states) - share:]
+            if not self._publish_offer(chunk, next_round, tx_count,
+                                       address, inflight=False):
+                continue
+            # trim AFTER the successful save: an aborted offer must
+            # leave its states with the victim
+            del states[len(states) - share:]
+            published += 1
+        return published
+
+    def _publish_offer(self, chunk: List, next_round: int,
+                       tx_count: int, address,
+                       inflight: bool = False) -> bool:
+        """Write one claim-protocol offer for `chunk`: the checkpoint
+        batch (finished open states, or the live in-flight plane when
+        ``inflight``), the verdict/static sidecars, and the meta file
+        thieves glob for. The caller trims its state list only on
+        True."""
+        if self.current_contract is None:
+            return False
         from ..smt import BitVec
         from ..support.checkpoint import save_checkpoint
 
         addr = address.value if isinstance(address, BitVec) \
             else address
         code_id = self._current_code_id
-        ship = self._verdict_payload(states[n - k * share:]) \
-            if SHIP_VERDICTS else None
-        published = 0
-        for _ in range(k):
-            # always the current tail slice: the victim's own work
-            # continues from the head
-            chunk = states[len(states) - share:]
-            self._offer_seq += 1
-            offer_id = f"{self.rank}_{self._offer_seq}"
-            batch = self.dir / f"offer_{offer_id}.batch"
+        ship = self._verdict_payload(chunk) if SHIP_VERDICTS else None
+        self._offer_seq += 1
+        offer_id = f"{self.rank}_{self._offer_seq}"
+        batch = self.dir / f"offer_{offer_id}.batch"
+        if inflight:
+            save_checkpoint(str(batch), next_round, [], addr, code_id,
+                            include_modules=False, inflight=chunk)
+        else:
             save_checkpoint(str(batch), next_round, chunk, addr,
                             code_id, include_modules=False)
-            if not batch.exists():  # save is best-effort; keep states
-                continue
-            paths = [batch]
-            if ship:
-                side = self.dir / f"offer_{offer_id}.verdicts"
-                from ..support.checkpoint import save_verdict_sidecar
+        if not batch.exists():  # save is best-effort; keep states
+            return False
+        paths = [batch]
+        if ship:
+            side = self.dir / f"offer_{offer_id}.verdicts"
+            from ..support.checkpoint import save_verdict_sidecar
 
-                entries = self._entries_for(chunk, ship)
-                if entries and save_verdict_sidecar(side, entries):
-                    paths.append(side)
-            # static-pass results ship like verdict sidecars
-            # (docs/static_pass.md): pure per-code-hash data, so the
-            # thief seeds its memo instead of re-deriving CFG/masks
+            entries = self._entries_for(chunk, ship)
+            if entries and save_verdict_sidecar(side, entries):
+                paths.append(side)
+        # static-pass results ship like verdict sidecars
+        # (docs/static_pass.md): pure per-code-hash data, so the
+        # thief seeds its memo instead of re-deriving CFG/masks
+        try:
+            from ..analysis.static_pass import memo as static_memo
+            from ..support.checkpoint import save_static_sidecar
+
+            sentries = static_memo.export_entries()
+            if sentries:
+                sside = self.dir / f"offer_{offer_id}.static"
+                if save_static_sidecar(sside, sentries):
+                    paths.append(sside)
+        except Exception as e:
+            log.debug("static sidecar export failed: %s", e)
+        meta = {
+            "contract": self.current_contract,
+            "code_id": code_id,
+            "tx_count": tx_count,
+            "round": next_round,
+            "victim": self.rank,
+            "states": len(chunk),
+            "inflight": bool(inflight),
+        }
+        meta_path = self.dir / f"offer_{offer_id}.meta.json"
+        tmp = meta_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, meta_path)  # thieves glob for *.meta.json
+        paths.append(meta_path)
+        # a live victim keeps its offer files fresh: the dead-
+        # thief clock must not start while the victim is still
+        # analyzing (see _collect)
+        if self._victim_hb is None:
+            self._victim_hb = _Heartbeat()
+            self._victim_hb.start()
+        self._victim_hb.add_paths(*paths)
+        self.outstanding[offer_id] = meta
+        self.stats["states_migrated"] += len(chunk)
+        self.stats["batches_out"] += 1
+        if inflight:
+            self.stats["midflight_steals"] += 1
             try:
-                from ..analysis.static_pass import memo as static_memo
-                from ..support.checkpoint import save_static_sidecar
+                from ..smt.solver.solver_statistics import (
+                    SolverStatistics,
+                )
 
-                sentries = static_memo.export_entries()
-                if sentries:
-                    sside = self.dir / f"offer_{offer_id}.static"
-                    if save_static_sidecar(sside, sentries):
-                        paths.append(sside)
-            except Exception as e:
-                log.debug("static sidecar export failed: %s", e)
-            meta = {
-                "contract": self.current_contract,
-                "code_id": code_id,
-                "tx_count": tx_count,
-                "round": next_round,
-                "victim": self.rank,
-                "states": len(chunk),
-            }
-            meta_path = self.dir / f"offer_{offer_id}.meta.json"
-            tmp = meta_path.with_suffix(".tmp")
-            tmp.write_text(json.dumps(meta))
-            os.replace(tmp, meta_path)  # thieves glob for *.meta.json
-            paths.append(meta_path)
-            # a live victim keeps its offer files fresh: the dead-
-            # thief clock must not start while the victim is still
-            # analyzing (see _collect)
-            if self._victim_hb is None:
-                self._victim_hb = _Heartbeat()
-                self._victim_hb.start()
-            self._victim_hb.add_paths(*paths)
-            self.outstanding[offer_id] = meta
-            # trim AFTER the successful save: an aborted offer must
-            # leave its states with the victim
-            del states[len(states) - share:]
-            self.stats["states_migrated"] += len(chunk)
-            self.stats["batches_out"] += 1
-            published += 1
-            trace.event("migrate.offer", offer=offer_id,
-                        states=len(chunk), round=next_round)
-            log.info("rank %d: migrated %d open states (offer %s, "
-                     "%d thieves idle)", self.rank, len(chunk),
-                     offer_id, len(thieves))
-        return published
+                SolverStatistics().bump(midflight_steals=1,
+                                        lanes_exported=len(chunk))
+            except Exception:  # telemetry only
+                pass
+        trace.event("migrate.offer", offer=offer_id,
+                    states=len(chunk), round=next_round,
+                    inflight=bool(inflight))
+        log.info("rank %d: migrated %d %s states (offer %s)",
+                 self.rank, len(chunk),
+                 "in-flight" if inflight else "open", offer_id)
+        return True
+
+    @staticmethod
+    def _constraints_of(state):
+        """The constraint set of either an open WorldState or an
+        in-flight GlobalState (mid-flight offers ship the latter)."""
+        ws = getattr(state, "world_state", None)
+        return (ws if ws is not None else state).constraints
 
     def _verdict_payload(self, states: List):
         """Pre-export feasibility screen over the shipped slice: the
@@ -376,7 +490,7 @@ class MigrationBus:
             vc = verdict_mod.cache()
             if vc is None:
                 return None
-            check_batch([ws.constraints for ws in states])
+            check_batch([self._constraints_of(s) for s in states])
             return vc
         except Exception as e:
             log.debug("pre-export screen failed (%s); shipping "
@@ -388,16 +502,17 @@ class MigrationBus:
             except Exception:
                 return None
 
-    @staticmethod
-    def _entries_for(chunk: List, vc) -> List:
+    @classmethod
+    def _entries_for(cls, chunk: List, vc) -> List:
         """Cached proofs restricted to the chunk's constraint
         prefixes, as picklable (terms, verdict, model) triples."""
         try:
             term_lists = []
-            for ws in chunk:
-                getter = getattr(ws.constraints, "get_all_constraints",
+            for state in chunk:
+                constraints = cls._constraints_of(state)
+                getter = getattr(constraints, "get_all_constraints",
                                  None)
-                cons = getter() if getter else list(ws.constraints)
+                cons = getter() if getter else list(constraints)
                 term_lists.append(
                     [c.raw for c in cons if type(c) != bool])
             return vc.export_entries(term_lists)
@@ -582,6 +697,49 @@ class _Heartbeat:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class _LaneExportClient:
+    """Window-boundary export protocol between the lane engine and the
+    migration bus (lane_engine._window_export, docs/checkpoint.md).
+    `want(live)` sizes the slice to take from the live wave's tail —
+    nonzero only when thieves are asking, the wave is big enough, and
+    the cooldown has elapsed; `deliver(states)` publishes the
+    materialized lanes as one inflight offer (False = the engine parks
+    them locally instead — work moves, never lost)."""
+
+    def __init__(self, bus: "MigrationBus"):
+        self.bus = bus
+
+    def want(self, live: int) -> int:
+        bus = self.bus
+        if bus.current_contract is None or bus._round is None:
+            return 0
+        if live < MIDFLIGHT_MIN_LIVE:
+            return 0
+        if time.monotonic() - bus._midflight_last \
+                < MIDFLIGHT_COOLDOWN_S:
+            return 0
+        thieves = bus._pending_requests()
+        if not thieves:
+            return 0
+        from .cost_model import midwave_share
+
+        # one offer per boundary: the next window's boundary serves
+        # any remaining thieves (the wave re-sizes in between)
+        return midwave_share(live, len(thieves))
+
+    def deliver(self, states) -> bool:
+        bus = self.bus
+        ctx = bus._round
+        if ctx is None or not states:
+            return False
+        next_round, tx_count, address = ctx
+        if bus._publish_offer(list(states), next_round, tx_count,
+                              address, inflight=True):
+            bus._midflight_last = time.monotonic()
+            return True
+        return False
 
 
 def analyze_batch(meta: dict, batch_path, timeout: int,

@@ -29,6 +29,7 @@ def make_cmd_args(**overrides) -> SimpleNamespace:
         tpu_lanes=0,
         tpu_mesh=-1,
         checkpoint=None,
+        resume=None,
         migration_bus=None,
     )
     unknown = set(overrides) - set(base)

@@ -13,7 +13,17 @@ state collapses to a serializable core:
 * the transaction-id counter (fresh symbols on resume never collide
   with checkpointed ones);
 * each detection module's issues and dedup cache, so resumed runs
-  neither lose nor double-report findings.
+  neither lose nor double-report findings;
+* (v4, docs/checkpoint.md) an optional **in-flight lane plane**: live
+  GlobalStates mid-transaction — per-lane PC, call frame, stack,
+  memory, storage slot tables, gas intervals, path constraints and
+  pending PotentialIssues/promotions all ride the same flat term
+  table.  A resumed run finishes the interrupted round from them
+  before the normal round loop continues (laser/svm.py resume_exec),
+  which is what lets work stealing split *any* wave (not just drained
+  worklists), lets a SIGTERM'd rank re-enter the queue as resumable
+  work, and lets ``myth analyze --resume`` continue a crashed run
+  from its last window boundary.
 
 Term DAGs are serialized as a FLAT topologically-ordered node table
 (terms pickle as table references), so arbitrarily deep constraint /
@@ -39,13 +49,29 @@ import tempfile
 from typing import Any, Dict, Optional
 
 from ..smt import terms as T
+from .telemetry import trace
 
 log = logging.getLogger(__name__)
 
-VERSION = 3
+#: v4: optional in-flight GlobalState payload ("inflight") + detection-
+#: module persistent ids. Loads REJECT other versions (resume falls
+#: back to a fresh run — skew-safe, never a crash): a v3 snapshot's
+#: states would restore, but its pickled PotentialIssue.detector
+#: references would duplicate module singletons.
+VERSION = 4
 
 #: observability: how many loads resumed vs fell back to fresh runs
 RESUME_STATS = {"loaded": 0, "failed": 0}
+
+
+def live_enabled() -> bool:
+    """The live-checkpoint master gate (MTPU_CKPT, default on; "0"
+    restores pre-checkpoint behavior bit-for-bit): mid-flight wave
+    splitting over the migration bus, the SIGTERM/fatal resume dump,
+    and the corpus per-contract checkpoint wiring all stand down when
+    off. Round-boundary checkpoints requested explicitly via
+    --checkpoint are NOT gated — the caller asked for them."""
+    return os.environ.get("MTPU_CKPT", "1") != "0"
 
 
 def code_identity(contract) -> str:
@@ -84,7 +110,13 @@ class _Pickler(pickle.Pickler):
 
     def persistent_id(self, obj):
         # CFG nodes chain into the whole explored statespace; dynamic
-        # loaders hold live RPC sessions — both are dropped
+        # loaders hold live RPC sessions — both are dropped. Detection
+        # modules (referenced by in-flight states' pending
+        # PotentialIssues) serialize by NAME: the loading process
+        # resolves them against its own module singletons, so a
+        # shipped candidate issue lands on the thief's detector
+        # instead of a deep-pickled duplicate of the victim's.
+        from ..analysis.module.base import DetectionModule
         from ..laser.cfg import Node
         from .loader import DynLoader
 
@@ -92,11 +124,20 @@ class _Pickler(pickle.Pickler):
             return "node"
         if isinstance(obj, DynLoader):
             return "dynld"
+        if isinstance(obj, DetectionModule):
+            return ("module", type(obj).__name__)
         return None
 
 
 class _Unpickler(pickle.Unpickler):
     def persistent_load(self, pid):
+        if isinstance(pid, tuple) and pid and pid[0] == "module":
+            from ..analysis.module.loader import ModuleLoader
+
+            for module in ModuleLoader().get_detection_modules():
+                if type(module).__name__ == pid[1]:
+                    return module
+            return None  # module set differs: candidate is dropped
         return None  # nodes / dynloaders restore as absent
 
 
@@ -271,45 +312,57 @@ def load_static_sidecar(path) -> list:
 
 def save_checkpoint(path: str, round_index: int, open_states,
                     target_address: int, code_id: str,
-                    include_modules: bool = True) -> None:
+                    include_modules: bool = True,
+                    inflight=None) -> bool:
     """Atomically write a resumable snapshot after a completed
     transaction round. Failures are logged, never raised — a
     checkpoint must not kill the analysis it protects.
     include_modules=False writes a MIGRATION batch: the open states
     travel, detector issues/caches stay with the exporting rank
-    (parallel/migrate.py)."""
+    (parallel/migrate.py). ``inflight`` is the live lane plane
+    (docs/checkpoint.md): GlobalStates mid-way through round
+    ``round_index - 1`` — a resumed run finishes that round from them
+    before the loop continues at ``round_index``. Returns True when
+    the file landed."""
     from ..laser.transaction import tx_id_manager
 
+    inflight = list(inflight or [])
     try:
-        body = io.BytesIO()
-        pickler = _Pickler(body, protocol=pickle.HIGHEST_PROTOCOL)
-        pickler.dump({
-            "round": round_index,
-            "open_states": list(open_states),
-            "target_address": target_address,
-            "tx_counter": tx_id_manager._next,
-            "keccak": _keccak_state(),
-            "modules": _module_state() if include_modules else {},
-        })
-        head = io.BytesIO()
-        pickle.dump(
-            {"version": VERSION, "code_id": code_id,
-             "terms": _dag_rows(pickler.roots.values())},
-            head, protocol=pickle.HIGHEST_PROTOCOL)
+        with trace.span("ckpt.export", states=len(open_states),
+                        inflight=len(inflight)):
+            body = io.BytesIO()
+            pickler = _Pickler(body, protocol=pickle.HIGHEST_PROTOCOL)
+            pickler.dump({
+                "round": round_index,
+                "open_states": list(open_states),
+                "inflight": inflight,
+                "target_address": target_address,
+                "tx_counter": tx_id_manager._next,
+                "keccak": _keccak_state(),
+                "modules": _module_state() if include_modules else {},
+            })
+            head = io.BytesIO()
+            pickle.dump(
+                {"version": VERSION, "code_id": code_id,
+                 "terms": _dag_rows(pickler.roots.values())},
+                head, protocol=pickle.HIGHEST_PROTOCOL)
 
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(os.path.abspath(path)) or ".",
-            prefix=".ckpt-")
-        with os.fdopen(fd, "wb") as f:
-            f.write(head.getvalue())
-            f.write(body.getvalue())
-        os.replace(tmp, path)
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(os.path.abspath(path)) or ".",
+                prefix=".ckpt-")
+            with os.fdopen(fd, "wb") as f:
+                f.write(head.getvalue())
+                f.write(body.getvalue())
+            os.replace(tmp, path)
         log.info(
-            "checkpoint: round %d, %d open states -> %s (%d bytes)",
-            round_index, len(open_states), path,
+            "checkpoint: round %d, %d open + %d in-flight states -> "
+            "%s (%d bytes)",
+            round_index, len(open_states), len(inflight), path,
             head.tell() + body.tell())
+        return True
     except Exception as e:  # pragma: no cover - best-effort by design
         log.warning("checkpoint save failed (%s); continuing", e)
+        return False
 
 
 def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
@@ -324,10 +377,14 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
         return None
     RESUME_STATS["failed"] += 1  # flipped to loaded on success
     try:
-        with open(path, "rb") as f:
+        with trace.span("ckpt.import"), open(path, "rb") as f:
             head = pickle.load(f)
             if head.get("version") != VERSION:
-                log.warning("checkpoint %s: unsupported version %s",
+                # version skew (old rank in a mixed-build fleet, or a
+                # pre-v4 file on disk): skipped, never crashed on —
+                # the run starts fresh and overwrites it
+                log.warning("checkpoint %s: unsupported version %s; "
+                            "starting fresh",
                             path, head.get("version"))
                 return None
             if head.get("code_id") != code_id:
@@ -345,6 +402,7 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
         # leave half-restored global state behind
         round_index = payload["round"]
         open_states = payload["open_states"]
+        inflight = list(payload.get("inflight", ()))
         tx_counter = payload["tx_counter"]
         keccak = {
             key: payload["keccak"][key]
@@ -381,7 +439,101 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
 
     RESUME_STATS["failed"] -= 1
     RESUME_STATS["loaded"] += 1
-    log.info("checkpoint: resuming at round %d with %d open states",
-             round_index, len(open_states))
+    log.info("checkpoint: resuming at round %d with %d open + %d "
+             "in-flight states",
+             round_index, len(open_states), len(inflight))
     return {"round": round_index, "open_states": open_states,
+            "inflight": inflight,
             "target_address": payload["target_address"]}
+
+
+# -- live dumps (SIGTERM / fatal — docs/checkpoint.md) -------------------
+
+
+def snapshot_live_states(laser) -> list:
+    """The in-flight half of a live dump: the host worklist verbatim,
+    plus one window-boundary seed state per live device lane — each
+    engine's lane ctxs rebuild as (seed template + accumulated path
+    conditions), pure host work that is safe from a signal handler
+    (no device access; a lane's progress since its seed re-executes
+    on resume, restricted to its recorded branch by the conditions).
+    Best-effort per state: a state that fails to rebuild is dropped
+    (it re-runs from the round checkpoint instead)."""
+    states = list(getattr(laser, "work_list", ()) or ())
+    # the state mid-step (already popped from the worklist) and the
+    # terminal states whose PotentialIssue wave has not discharged
+    # yet: both re-enter the worklist on resume — one re-executed
+    # step / re-ended transaction each, absorbed by issue dedup
+    current = getattr(laser, "_ckpt_current_state", None)
+    if current is not None:
+        states.append(current)
+    states.extend(getattr(laser, "_pi_wave", ()) or ())
+    engines = getattr(laser, "_lane_engines", None) or {}
+    for engine in list(engines.values()):
+        try:
+            states.extend(engine.live_seed_states())
+        except Exception:
+            continue
+    return states
+
+
+def write_resume_checkpoint(laser, path, code_id: str) -> bool:
+    """Dump a FULL live checkpoint for the analysis `laser` is mid-way
+    through: open states of the current round, the in-flight plane
+    (snapshot_live_states), detector issues/caches, keccak state and
+    the tx counter. Called from the flight recorder's SIGTERM/fatal
+    hook — single-flight there, never raises here."""
+    try:
+        ctx = getattr(laser, "_ckpt_round_ctx", None)
+        if ctx is None:
+            return False  # no round running: nothing resumable yet
+        next_round, _tx_count, address = ctx
+        from ..smt import BitVec
+
+        addr = address.value if isinstance(address, BitVec) else address
+        return save_checkpoint(
+            str(path), next_round, list(laser.open_states), addr,
+            code_id, include_modules=True,
+            inflight=snapshot_live_states(laser))
+    except Exception as e:
+        log.warning("live resume dump failed (%s)", e)
+        return False
+
+
+def arm_live_dump(laser, path, code_id: str) -> None:
+    """Register the SIGTERM/fatal resume-checkpoint provider with the
+    flight recorder (PR 9): when the process dies with this analysis
+    mid-round, ``<out-dir>/flightrec/resume_rank<r>.ckpt`` (and the
+    analysis's own --checkpoint file, when set) capture the live
+    plane, so the contract re-enters the queue as resumable work.
+    Latest analysis wins — one resume file per rank."""
+    if not live_enabled():
+        return
+    try:
+        import weakref
+
+        from .telemetry import flightrec
+
+        ref = weakref.ref(laser)
+
+        def provider(dest_dir, rank):
+            l = ref()
+            if l is None:
+                return None
+            resume_path = os.path.join(
+                str(dest_dir), f"resume_rank{rank}.ckpt")
+            if not write_resume_checkpoint(l, resume_path, code_id):
+                return None
+            if path and os.path.abspath(str(path)) != \
+                    os.path.abspath(resume_path):
+                try:
+                    import shutil
+
+                    shutil.copyfile(resume_path, str(path))
+                except OSError:
+                    pass
+            return resume_path
+
+        flightrec.register_resume_provider(provider)
+    except Exception as e:  # telemetry only
+        log.debug("live-dump arming failed: %s", e)

@@ -41,6 +41,19 @@ _CFG = {"dir": None, "rank": 0}
 _INSTALLED = {"excepthook": False, "sigterm": False}
 _DUMPING = threading.Lock()
 
+#: live resume-checkpoint provider (support/checkpoint.arm_live_dump):
+#: called as fn(flightrec_dir, rank) during dump(), expected to write
+#: resume_rank<rank>.ckpt and return its path (or None). Latest
+#: analysis wins — a rank runs one contract at a time.
+_RESUME_PROVIDER = {"fn": None}
+
+
+def register_resume_provider(fn) -> None:
+    """Arm the checkpoint path: on SIGTERM/fatal the dump also writes
+    a live resume checkpoint beside the spans/metrics artifacts
+    (single-flight and never-raises like every other hook here)."""
+    _RESUME_PROVIDER["fn"] = fn
+
 
 def configure(out_dir=None, rank: Optional[int] = None) -> None:
     if out_dir is not None:
@@ -106,6 +119,15 @@ def dump(reason: str, exc_info=None) -> Optional[Path]:
                 os.replace(tmp, dest / name)
             except Exception:
                 continue
+        # live resume checkpoint (support/checkpoint.arm_live_dump):
+        # the dying rank's contract re-enters the queue as resumable
+        # work instead of restarting from zero
+        provider = _RESUME_PROVIDER["fn"]
+        if provider is not None:
+            try:
+                provider(dest, rank)
+            except Exception:
+                pass
         return dest
     except Exception:
         return None

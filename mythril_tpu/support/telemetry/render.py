@@ -56,6 +56,7 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("subsumed", "lanes_subsumed"),
         ("rounds", "merge_rounds"),
         ("or_terms", "or_terms_built"),
+        ("gas_widened", "gas_widened_lanes"),
     )),
     ("Solver pool", "docs/solver_pool.md",
      lambda c: c.get("pool_workers", 0) > 1
@@ -88,6 +89,14 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
      ("verdicts_shipped", "verdicts_replayed"), (
         ("shipped", "verdicts_shipped"),
         ("replayed", "verdicts_replayed"),
+    )),
+    ("Checkpoint/resume", "docs/checkpoint.md",
+     ("lanes_exported", "lanes_imported", "midflight_steals",
+      "resume_rounds"), (
+        ("exported", "lanes_exported"),
+        ("imported", "lanes_imported"),
+        ("midflight_steals", "midflight_steals"),
+        ("resume_rounds", "resume_rounds"),
     )),
 )
 

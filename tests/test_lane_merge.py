@@ -299,3 +299,120 @@ class TestEndToEnd:
             issues_on, d_on = _analyze(code, True, 0, 2)
             assert issues_on == issues_off
             assert d_on["batch_queries"] <= d_off["batch_queries"]
+
+
+def _build_uneven_diamond(k=4, dup_levels=2, pad=3):
+    """Diamond storm whose arms are STEP-balanced but GAS-unbalanced:
+    both arms of level i execute pad*2^i stack-neutral filler pairs,
+    but the false arm's pair is PUSH1/POP (3+2 gas) while the true
+    arm's is CALLER/POP (2+2 gas) — so the arms stay in device
+    lockstep (identical pc/stack/memory/storage at every rejoin) while
+    every distinct branch choice lands on a UNIQUE total gas (2^i
+    scaling: no equal-gas permutation twins). The shape only the
+    gas-widening merge (MTPU_MERGE_GASWIDEN, docs/lane_merge.md) can
+    collapse."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray()
+    for i in range(k):
+        bit = 0 if i < dup_levels else i
+        c += push(bit) + bytes([op["CALLDATALOAD"]])
+        c += push(1) + bytes([op["AND"]])
+        j = len(c)
+        c += push(0, 2) + bytes([op["JUMPI"]])
+        c += bytes([op["JUMPDEST"]])
+        for _ in range(pad * (1 << i)):  # false arm: 5 gas / 2 steps
+            c += push(0) + bytes([op["POP"]])
+        jf = len(c)
+        c += push(0, 2) + bytes([op["JUMP"]])
+        t = len(c)
+        c[j + 1:j + 3] = t.to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"]])
+        for _ in range(pad * (1 << i)):  # true arm: 4 gas / 2 steps
+            c += bytes([op["CALLER"], op["POP"]])
+        jt = len(c)
+        c += push(0, 2) + bytes([op["JUMP"]])
+        r = len(c)
+        c[jf + 1:jf + 3] = r.to_bytes(2, "big")
+        c[jt + 1:jt + 3] = r.to_bytes(2, "big")
+        c += bytes([op["JUMPDEST"]])
+    c += push(31) + bytes([op["CALLDATALOAD"]])
+    c += push(0xDEADBEEF, 4) + bytes([op["EQ"]])
+    j = len(c)
+    c += push(0, 2) + bytes([op["JUMPI"]])
+    c += bytes([op["STOP"]])
+    t = len(c)
+    c[j + 1:j + 3] = t.to_bytes(2, "big")
+    c += bytes([op["JUMPDEST"], 0xFE])
+    return bytes(c)
+
+
+class TestGasWidening:
+    def test_uneven_gas_diamond_widens(self, monkeypatch):
+        """Lane seam: an uneven-gas diamond merges ONLY under the
+        gas-widening merge; issue identity holds across widening
+        on/off and merge-off, and the off path stays bit-for-bit
+        (zero merges — the arms' gas intervals differ)."""
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from mythril_tpu.laser import lane_engine
+        from mythril_tpu.smt.solver.solver_statistics import (
+            SolverStatistics,
+        )
+
+        code = _build_uneven_diamond(k=4, dup_levels=0, pad=1)
+        lane_engine.PATH_HISTORY[code] = 64
+        lane_engine.FORCE_WIDTH = 64
+        old_window = lane_engine.DEFAULT_WINDOW
+        lane_engine.DEFAULT_WINDOW = 32
+        try:
+            lane_engine.warm_variant(64, len(code), {}, 32, 8192,
+                                     seed_bucket=16, block=True)
+            ss = SolverStatistics()
+            monkeypatch.setenv("MTPU_MERGE_GASWIDEN", "0")
+            issues_nowiden, d_nowiden = _analyze(code, True, 64, 1)
+            w0 = ss.gas_widened_lanes
+            monkeypatch.setenv("MTPU_MERGE_GASWIDEN", "1")
+            issues_widen, d_widen = _analyze(code, True, 64, 1)
+            widened = ss.gas_widened_lanes - w0
+            issues_off, _ = _analyze(code, False, 64, 1)
+        finally:
+            lane_engine.FORCE_WIDTH = None
+            lane_engine.DEFAULT_WINDOW = old_window
+        assert issues_widen == issues_nowiden == issues_off
+        assert issues_widen, "rig must produce a reachable issue"
+        # the uneven arms are invisible to the gas-exact merge...
+        assert d_nowiden["lanes_merged"] == 0
+        # ...and collapse under widening, with the widen counter live
+        assert d_widen["lanes_merged"] > 0
+        assert widened > 0
+
+    def test_balanced_diamond_unchanged_by_widening_gate(
+            self, monkeypatch):
+        """A gas-balanced diamond merges identically with widening on
+        or off (the gate only relaxes the grouping key)."""
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from mythril_tpu.laser import lane_engine
+
+        code = _build_diamond(k=4, dup_levels=2)
+        lane_engine.PATH_HISTORY[code] = 64
+        lane_engine.FORCE_WIDTH = 64
+        old_window = lane_engine.DEFAULT_WINDOW
+        lane_engine.DEFAULT_WINDOW = 32
+        try:
+            lane_engine.warm_variant(64, len(code), {}, 32, 8192,
+                                     seed_bucket=16, block=True)
+            monkeypatch.setenv("MTPU_MERGE_GASWIDEN", "0")
+            issues_a, d_a = _analyze(code, True, 64, 1)
+            monkeypatch.setenv("MTPU_MERGE_GASWIDEN", "1")
+            issues_b, d_b = _analyze(code, True, 64, 1)
+        finally:
+            lane_engine.FORCE_WIDTH = None
+            lane_engine.DEFAULT_WINDOW = old_window
+        assert issues_a == issues_b
+        assert d_a["lanes_merged"] == d_b["lanes_merged"]
+        assert d_a["lanes_subsumed"] == d_b["lanes_subsumed"]

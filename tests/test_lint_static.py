@@ -193,3 +193,59 @@ def test_wall_clock_outside_rule4_roots_ok(tmp_path):
             return time.time()
     """)
     assert findings == []
+
+
+def test_raw_pickle_in_package_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/parallel/bad.py", """\
+        import pickle
+
+        def ship(states, f):
+            pickle.dump(states, f)
+            return pickle.load(f)
+    """)
+    assert [f.rule for f in findings] == [
+        "raw-pickle-outside-checkpoint",
+        "raw-pickle-outside-checkpoint",
+    ]
+    assert [f.line for f in findings] == [4, 5]
+
+
+def test_raw_pickle_in_checkpoint_exempt(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/support/checkpoint.py", """\
+        import pickle
+
+        def save(obj, f):
+            pickle.dump(obj, f)
+    """)
+    assert findings == []
+
+
+def test_raw_pickle_outside_package_ok(tmp_path):
+    findings = _lint_source(tmp_path, "tools/scratch.py", """\
+        import pickle
+
+        def save(obj, f):
+            pickle.dumps(obj)
+    """)
+    assert findings == []
+
+
+def test_raw_pickle_allowlist_suppresses(tmp_path):
+    path = tmp_path / "mythril_tpu/ops/cachefile.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("import pickle\nBLOB = pickle.dumps([1, 2])\n")
+    allow = tmp_path / "tools" / "lint_allowlist.txt"
+    allow.parent.mkdir(parents=True, exist_ok=True)
+    allow.write_text(
+        "mythril_tpu/ops/cachefile.py:raw-pickle-outside-checkpoint"
+        "  # term-free bytes\n")
+    old_repo, old_allow = lint_static.REPO, lint_static.ALLOWLIST
+    lint_static.REPO, lint_static.ALLOWLIST = tmp_path, allow
+    try:
+        findings = [f for f in lint_static.lint_file(path)
+                    if not lint_static._allowed(
+                        f, lint_static._load_allowlist())]
+    finally:
+        lint_static.REPO, lint_static.ALLOWLIST = old_repo, old_allow
+    assert findings == []

@@ -104,9 +104,14 @@ def test_midround_steal_parity(tmp_path):
     """The wave sheds WHILE a round is still executing (the mid-round
     yield in laser/svm.py): per-path delay keeps the victim's round 1
     running long after the thief drained, the poll period is tightened,
-    and the merged report must STILL match the no-migration run."""
+    and the merged report must STILL match the no-migration run.
+    MTPU_CKPT=0 pins the FINISHED-state yield path: with live
+    checkpointing on, the mid-flight wave split (docs/checkpoint.md,
+    tests/test_checkpoint_live.py, smoke stage 11) ships the live
+    worklist even earlier and this gate's counter never fires."""
     files = _corpus(tmp_path)
-    rig = {"MTPU_PATH_DELAY": "0.5", "MTPU_MIDROUND_K": "64"}
+    rig = {"MTPU_PATH_DELAY": "0.5", "MTPU_MIDROUND_K": "64",
+           "MTPU_CKPT": "0"}
 
     plain = _run(tmp_path, files, "plain", migrate=False,
                  extra_env=rig)

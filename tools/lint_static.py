@@ -43,6 +43,19 @@ wall-clock interval there. Use ``time.monotonic()`` (or
 (not intervals) should come from ``datetime`` so the intent is
 explicit.
 
+Rule 5 — raw-pickle-outside-checkpoint (the PR-10 lane-plane-sidecar
+class): calling ``pickle.dump`` / ``pickle.load`` / ``pickle.dumps`` /
+``pickle.loads`` anywhere in ``mythril_tpu/`` outside
+``mythril_tpu/support/checkpoint.py``. Term-bearing object graphs
+(states, constraints, issues) MUST travel through the checkpoint
+helpers (``dump_with_terms`` / the sidecar savers): raw pickle
+recurses arbitrarily deep term DAGs (RecursionError on loop-heavy
+analyses), breaks hash-consing on load (duplicate terms with fresh
+tids defeat every fingerprint-keyed cache), and silently skips the
+version/code-identity framing the sidecar format carries. The
+checkpoint module is the one sanctioned seam; new sites must route
+through it — or be explicitly allowlisted with a reason.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -113,6 +126,20 @@ _RULE3_ROOTS = ("mythril_tpu/ops/", "mythril_tpu/smt/solver/")
 #: wall-clock interval is a latent NTP-step bug
 _RULE4_ROOTS = ("mythril_tpu/parallel/",
                 "mythril_tpu/support/telemetry/")
+
+#: rule-5: the one file allowed to touch raw pickle (it IS the
+#: sanctioned term-safe serialization seam), and the calls banned
+#: everywhere else in the package
+_RULE5_EXEMPT = "mythril_tpu/support/checkpoint.py"
+_PICKLE_CALLS = frozenset(("dump", "load", "dumps", "loads"))
+
+
+def _is_raw_pickle_call(node: ast.Call) -> bool:
+    """pickle.dump(...) / pickle.load(...) / dumps / loads."""
+    fn = node.func
+    return (isinstance(fn, ast.Attribute) and fn.attr in _PICKLE_CALLS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "pickle")
 
 
 def _is_wall_clock_call(node: ast.Call) -> bool:
@@ -241,6 +268,18 @@ def lint_file(path: Path) -> List[Finding]:
                     "steps corrupt wall intervals; use "
                     "time.monotonic(), or datetime for true "
                     "timestamps)"))
+
+    if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_raw_pickle_call(node):
+                out.append(Finding(
+                    rel, node.lineno, "raw-pickle-outside-checkpoint",
+                    "raw pickle call outside support/checkpoint.py "
+                    "(term-bearing graphs must ride dump_with_terms/"
+                    "the sidecar helpers: deep-DAG recursion, broken "
+                    "hash-consing, and missing version framing "
+                    "otherwise; allowlist deliberate term-free "
+                    "sites with a reason)"))
     return out
 
 
