@@ -601,22 +601,29 @@ def bench_config5(n_lanes=32768, k=None, host_k=12):
             "drained_records": stats.get("records"),
             "parked_states": stats.get("parked"),
             "spill_reseeded": stats.get("reseeded"),
+            # streaming retire pipeline (docs/drain_pipeline.md §1b)
+            "retire_chunks": stats.get("retire_chunks"),
+            "retire_overlap_ms": round(
+                stats.get("retire_overlap_ms", 0), 1),
+            "spill_merged": stats.get("spill_merged"),
             "model_repairs": {k: v - repairs0.get(k, 0)
                               for k, v in repair.STATS.items()},
             "note": "host measured at 2^12 paths (rate ~flat in path "
-                    "count for this shape); remaining scale levers are "
-                    "host-side terminal materialization and the retire "
-                    "pull (ROADMAP)",
+                    "count for this shape); the retire side now "
+                    "streams (chunked gathers, deferred pulls, "
+                    "merge-before-spill — docs/drain_pipeline.md §1b)",
             "defined_size_status":
-                "LIVE 64k-wide symbolic windows kernel-fault this TPU "
-                "worker process (reproduced with default planes AND "
-                "memory planes cut 4x; init and all-dead warm windows "
-                "at 64k run clean) - worker/runtime limit, engine "
-                "falls back soundly; 32k-wide is stable. The 65536-"
-                "path overflow regime through this 32k engine is "
-                "runnable via BENCH_CONFIG5_K=16 (spill/refill churn "
-                "roughly halves the clean-scale rate; one measured "
-                "run is recorded in BASELINE.md, dated).",
+                "The 64k-LIVE kernel-fault shape was the escalation "
+                "retire's width-scaled gather; retire gathers are now "
+                "bounded by MTPU_RETIRE_CHUNK (default 1024 rows) "
+                "regardless of live width, and a worker that still "
+                "faults triggers the capacity autoprobe: pick_width "
+                "clamps to the bisected stable width (persisted to "
+                "stats.json) and overflow degrades via spill/refill "
+                "- never via fault. The 65536-path overflow regime "
+                "(BENCH_CONFIG5_K=16) runs through merge-before-"
+                "spill, which collapses rejoin twins before they "
+                "re-execute (BENCH_r10).",
         },
     }
 
@@ -1363,6 +1370,116 @@ def _smoke_merge():
     return result
 
 
+def _smoke_stream():
+    """Stage 12: the streaming retire/materialize gate
+    (docs/drain_pipeline.md, "streaming retire").
+
+    A rejoin-heavy OVERFLOW STORM — 2^7 diamond paths through a
+    32-lane engine, so windows park twins past both the in-dispatch
+    fast-retire budget (RCAP=16: the escalation gather engages) and
+    the lane capacity (over-budget forks spill to the host, and their
+    descendants re-seed — the REAL spill/refill seam, gated by nonzero
+    ``reseeded``) — runs once per config:
+
+    * STREAMING (MTPU_RETIRE_CHUNK=4): gates ``retire_chunks > 1``
+      (the escalation sets provably split into bounded gathers),
+      ``spill_merged_lanes > 0`` (rejoin twins collapsed BEFORE
+      materialization), nonzero ``retire_overlap_ms`` (deferred chunk
+      pulls hid behind following windows), and a parked-state count
+      strictly below the monolithic run (the spill regime stopped
+      re-executing merged twins);
+    * MONOLITHIC (MTPU_STREAM=0): zero chunk gathers booked, and an
+      issue set identical to the streaming run — the whole pipeline
+      is a perf transform, not a semantic one.
+
+    Wall-clock is NOT gated (single-CPU container constraint): the
+    evidence is allocation behavior and avoided-work counters."""
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.analysis_args import make_cmd_args
+
+    code = build_diamond_contract(k=7, dup_levels=0)
+    ss = SolverStatistics()
+
+    def analyze(stream_on, chunk):
+        lane_engine.FORCE_STREAM = stream_on
+        lane_engine.FORCE_RETIRE_CHUNK = chunk
+        try:
+            reset_analysis_state()
+            c0 = dict(ss.batch_counters())
+            lane_engine.RUN_STATS_TOTAL = {}
+            dis = MythrilDisassembler(eth=None)
+            address, _ = dis.load_from_bytecode(code.hex(),
+                                                bin_runtime=True)
+            analyzer = MythrilAnalyzer(
+                disassembler=dis,
+                cmd_args=make_cmd_args(execution_timeout=120,
+                                       tpu_lanes=32),
+                strategy="bfs", address=address)
+            report = analyzer.fire_lasers(modules=None,
+                                          transaction_count=1)
+            c1 = ss.batch_counters()
+            eng = dict(lane_engine.RUN_STATS_TOTAL)
+            return {
+                "issues": sorted((i.swc_id, i.address, i.title)
+                                 for i in report.issues.values()),
+                "counters": {k: round(c1[k] - c0.get(k, 0), 1)
+                             for k in ("retire_chunks",
+                                       "spill_merged_lanes",
+                                       "retire_overlap_ms")},
+                "ring_high_water": c1.get("ring_high_water", 0),
+                "parked": eng.get("parked", 0),
+                "reseeded": eng.get("reseeded", 0),
+            }
+        finally:
+            lane_engine.FORCE_STREAM = None
+            lane_engine.FORCE_RETIRE_CHUNK = None
+
+    lane_engine.PATH_HISTORY[code] = 128
+    lane_engine.FORCE_WIDTH = 32
+    try:
+        lane_engine.warm_variant(
+            32, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        stream = analyze(True, 4)
+        mono = analyze(False, None)
+    finally:
+        lane_engine.FORCE_WIDTH = None
+
+    sc = stream["counters"]
+    result = {
+        "stream": dict(sc, ring_high_water=stream["ring_high_water"]),
+        "monolithic_retire_chunks": mono["counters"]["retire_chunks"],
+        "parked": {"stream": stream["parked"],
+                   "monolithic": mono["parked"]},
+        # the spill-seam proof lives on the MONOLITHIC run: the
+        # streaming run collapses the storm before it can overflow
+        # (measured: parked 224 -> 1), so ITS reseed count honestly
+        # drops to ~0 — which is the point of merge-before-spill
+        "spill_reseeded": {"stream": stream["reseeded"],
+                           "monolithic": mono["reseeded"]},
+        "issues_identical": stream["issues"] == mono["issues"],
+        "issues": stream["issues"],
+    }
+    result["ok"] = bool(
+        sc["retire_chunks"] > 1
+        and sc["spill_merged_lanes"] > 0
+        and sc["retire_overlap_ms"] > 0
+        and mono["reseeded"] > 0  # the rig provably storms the seam
+        and stream["parked"] < mono["parked"]
+        and mono["counters"]["retire_chunks"] == 0
+        and result["issues_identical"]
+        and len(stream["issues"]) > 0
+    )
+    return result
+
+
 def build_static_dead_contract(k=5, tail=160):
     """k symbolic forks, one SELFDESTRUCT branch (the reachable issue),
     a final concrete SSTORE, then a long pure-arithmetic tail to STOP:
@@ -2080,7 +2197,7 @@ def _smoke_ckpt():
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Eleven stages:
+    run-wide verdict cache — NO full corpus sweep. Twelve stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -2154,6 +2271,16 @@ def bench_smoke():
        export (+ JSONL twin), the crash flight recorder firing on an
        induced fatal in a subprocess, and traced-vs-untraced wall
        within 5% with issue identity. Any miss exits 1.
+
+    12. the streaming-retire gate (_smoke_stream,
+       docs/drain_pipeline.md "streaming retire"): a rejoin-heavy
+       overflow storm through the REAL spill seam gating
+       retire_chunks > 1 (bounded escalation gathers),
+       spill_merged_lanes > 0 (twins collapsed before
+       materialization), nonzero retire_overlap_ms (deferred pulls
+       hidden behind following windows), a parked-state count
+       strictly below the monolithic run, and issue identity vs
+       MTPU_STREAM=0. Any miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -2368,6 +2495,22 @@ def bench_smoke():
     else:
         out["ckpt"] = {"skipped": True, "ok": True}
 
+    # stage 12: the streaming retire/materialize gate
+    # (docs/drain_pipeline.md "streaming retire"): a rejoin-heavy
+    # overflow storm through the real spill seam — chunked escalation
+    # gathers (retire_chunks > 1), merge-before-spill
+    # (spill_merged_lanes > 0), nonzero deferred-pull overlap, and
+    # issue identity vs the monolithic MTPU_STREAM=0 path;
+    # skippable via MTPU_SMOKE_STREAM=0
+    if os.environ.get("MTPU_SMOKE_STREAM", "1") != "0":
+        try:
+            out["stream"] = _smoke_stream()
+        except Exception as e:
+            out["stream"] = {"ok": False, "error": type(e).__name__,
+                             "detail": str(e)[:200]}
+    else:
+        out["stream"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -2410,7 +2553,12 @@ def bench_smoke():
           # provably splits mid-flight (report identity on/off,
           # balanced rank walls) and a SIGKILLed rank's restart
           # resumes to an identical report
-          and out["ckpt"].get("ok", False))
+          and out["ckpt"].get("ok", False)
+          # the streaming-retire gate: chunked gathers on the
+          # overflow storm, spill twins merged before
+          # materialization, deferred pulls provably hidden, and
+          # issue identity vs the monolithic path
+          and out["stream"].get("ok", False))
     return 0 if ok else 1
 
 

@@ -257,6 +257,171 @@ def _geo_bucket(k: int, cap: int, floor: int) -> int:
     return min(b, cap)
 
 
+# ---------------------------------------------------------------------------
+# streaming retire/materialize pipeline gates (docs/drain_pipeline.md,
+# "streaming retire"). MTPU_STREAM is the master gate (default on;
+# =0 restores the monolithic-retire behavior bit-for-bit);
+# MTPU_RETIRE_CHUNK bounds rows per retire gather (pow2-rounded so
+# compile keys repeat; 0 disables chunking specifically);
+# MTPU_MAT_WORKERS sizes the materialization ring's worker pool (K=1
+# stays the default — single-CPU container constraint, ROADMAP).
+# ---------------------------------------------------------------------------
+
+#: tri-state test/bench overrides (None = read the env)
+FORCE_STREAM: Optional[bool] = None
+FORCE_RETIRE_CHUNK: Optional[int] = None
+
+#: default rows-per-gather bound: at full plane caps a retire row is
+#: ~7 KB, so 1024 bounds any single gather's device output buffer to a
+#: few MB regardless of live width — live width stops being a
+#: single-allocation limit
+DEFAULT_RETIRE_CHUNK = 1024
+
+
+def stream_enabled() -> bool:
+    """The MTPU_STREAM master gate (default on). Off: monolithic
+    retire gathers, no spill merge, K=1 inline materialization —
+    today's behavior bit-for-bit."""
+    if FORCE_STREAM is not None:
+        return bool(FORCE_STREAM)
+    return os.environ.get("MTPU_STREAM", "1") != "0"
+
+
+def retire_chunk() -> int:
+    """Rows-per-gather bound for the chunked retire path (pow2-rounded
+    down, min 16 so the floors bucketing stays sane); 0 = monolithic
+    (MTPU_RETIRE_CHUNK=0, or the master gate off)."""
+    if not stream_enabled():
+        return 0
+    if FORCE_RETIRE_CHUNK is not None:
+        ch = int(FORCE_RETIRE_CHUNK)
+    else:
+        try:
+            ch = int(os.environ.get("MTPU_RETIRE_CHUNK",
+                                    str(DEFAULT_RETIRE_CHUNK)))
+        except ValueError:
+            ch = DEFAULT_RETIRE_CHUNK
+    if ch <= 0:
+        return 0
+    ch = max(ch, 4)  # tiny chunks exist for tests/smoke rigs only
+    return 1 << (ch.bit_length() - 1)  # pow2 floor: compile keys repeat
+
+
+def mat_workers() -> int:
+    """Materialization ring worker count (MTPU_MAT_WORKERS, default 1
+    — the single-CPU pool default; the ring structure is what scales)."""
+    if not stream_enabled():
+        return 1
+    try:
+        return max(1, int(os.environ.get("MTPU_MAT_WORKERS", "1")))
+    except ValueError:
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# capacity autoprobe (docs/drain_pipeline.md): on the first kernel-fault
+# fallback the engine binary-searches the max stable live width ONCE and
+# clamps pick_width (persisted into stats.json via parallel/cost_model
+# so subsequent runs — and the future daemon — never re-fault).
+# ---------------------------------------------------------------------------
+
+#: in-process clamp discovered by the autoprobe (None = no fault seen)
+CAPACITY_CLAMP: Optional[int] = None
+_FAULT_PROBED = False
+_CLAMP_WARNED = False
+
+
+def capacity_clamp() -> Optional[int]:
+    """The live-width clamp in force: this process's probe result, else
+    the one a prior run persisted into stats.json (cost_model)."""
+    if CAPACITY_CLAMP is not None:
+        return CAPACITY_CLAMP
+    try:
+        from ..parallel import cost_model
+
+        return cost_model.WIDTH_CLAMP
+    except Exception:  # pragma: no cover - cost model optional
+        return None
+
+
+def _probe_width(width: int, lane_kwargs: Optional[dict] = None) -> bool:
+    """One capacity probe: allocate the lane planes at `width` and run
+    the full-cap escalation retire gather — the exact allocation shape
+    that kernel-faults an over-capacity worker (BENCH_r08: init and
+    all-dead windows at 64k ran clean; the LIVE window's gather did
+    not). True = stable."""
+    lk = dict(lane_kwargs or {})
+    try:
+        st = symstep.init_sym_lanes(width, **lk)
+        ridx = jnp.full(_geo_bucket(1, width, min(64, width)), width,
+                        jnp.int32)
+        st, rows = _retire_rows(
+            st, ridx,
+            lk.get("stack_depth", 64), lk.get("memory_bytes", 4096),
+            lk.get("mem_records", 64), lk.get("storage_slots", 64))
+        jax.block_until_ready(rows)
+        del st, rows
+        return True
+    except Exception as e:
+        log.info("capacity probe at width %d failed: %s", width, e)
+        return False
+
+
+def note_kernel_fault(width: int,
+                      lane_kwargs: Optional[dict] = None,
+                      probe=None) -> Optional[int]:
+    """First kernel-fault fallback at `width`: re-probe that width in
+    isolation (a transient failure that probes clean must NOT clamp),
+    then bisect the pow2 widths below it for the largest stable one.
+    The clamp lands in CAPACITY_CLAMP + cost_model (stats.json) and is
+    logged at WARNING once. Runs at most once per process; returns the
+    clamp (None = no clamp)."""
+    global _FAULT_PROBED, CAPACITY_CLAMP
+    if _FAULT_PROBED or width < 128:
+        return CAPACITY_CLAMP
+    _FAULT_PROBED = True
+    probe = probe or _probe_width
+    try:
+        if probe(width, lane_kwargs):
+            log.info("width %d probes clean after engine failure — "
+                     "not a capacity fault, no clamp", width)
+            return None
+        # pow2 bisection over exponents in [64, width/2]
+        lo, hi = 64, width // 2
+        best = None
+        while lo <= hi:
+            mid = 1 << ((lo.bit_length() + hi.bit_length()) // 2 - 1)
+            mid = max(lo, min(mid, hi))
+            if probe(mid, lane_kwargs):
+                best = mid
+                if mid >= hi:
+                    break
+                lo = mid * 2
+            else:
+                if mid <= lo:
+                    break
+                hi = mid // 2
+        if best is None:
+            return None
+        CAPACITY_CLAMP = best
+        try:
+            from ..parallel import cost_model
+
+            cost_model.record_width_clamp(best)
+        except Exception:  # pragma: no cover - cost model optional
+            pass
+        log.warning(
+            "lane capacity autoprobe: %d-wide live windows fault this "
+            "worker; clamping pick_width to %d (persisted to "
+            "stats.json — subsequent runs clamp instead of re-faulting)",
+            width, best)
+        trace.event("lane.capacity_clamp", faulted=width, clamp=best)
+        return best
+    except Exception as e:  # pragma: no cover - probe best-effort
+        log.debug("capacity autoprobe failed: %s", e)
+        return None
+
+
 # ---- fused per-window device calls (one dispatch each; every extra
 # dispatch is a full round trip on a tunneled backend) -----------------------
 
@@ -1448,9 +1613,23 @@ def pick_width(cap: int, n_entries: int,
     pressure stalls parents until slots free, and the host
     spill/refill path absorbs overflow
     (tests/test_lane_spill_refill.py). Worklists that genuinely grow
-    pick a wider engine on the next sweep."""
+    pick a wider engine on the next sweep. A capacity-autoprobe clamp
+    (CAPACITY_CLAMP / stats.json via cost_model) caps the width below
+    any live-plane size that kernel-faulted this worker class — the
+    engine degrades through the spill/refill path instead of faulting
+    (logged at WARNING once when the clamp actually binds)."""
+    global _CLAMP_WARNED
     if FORCE_WIDTH is not None:
         return max(min(cap, FORCE_WIDTH), 1)
+    clamp = capacity_clamp()
+    if clamp is not None and clamp < cap:
+        if not _CLAMP_WARNED:
+            _CLAMP_WARNED = True
+            log.warning(
+                "lane width capped at %d by the capacity autoprobe "
+                "(configured cap %d kernel-faulted a worker; "
+                "overflow degrades via spill/refill)", clamp, cap)
+        cap = max(clamp, 1)
     if cap <= 64:
         return max(cap, 1)
     demand = max(n_entries * headroom,
@@ -1569,6 +1748,12 @@ class LaneEngine:
             "lanes_merged": 0, "lanes_subsumed": 0, "merge_rounds": 0,
             # static pre-analysis consumers (docs/static_pass.md)
             "static_retired": 0, "static_jump_patches": 0,
+            # streaming retire pipeline (docs/drain_pipeline.md):
+            # bounded gathers issued, D2H pull wall hidden behind the
+            # next window's execution, spill candidates merged before
+            # materialization, and the deferral ring's peak occupancy
+            "retire_chunks": 0, "retire_overlap_ms": 0,
+            "spill_merged": 0, "ring_high_water": 0,
         }
         # static-pass run context, set by svm per sweep (the engine is
         # cached across sweeps and transactions): the active-detector
@@ -1598,6 +1783,16 @@ class LaneEngine:
         #: live lane ctxs of an explore in progress (SIGTERM dump
         #: path: support/checkpoint.snapshot_live_states)
         self._explore_ctxs = None
+        #: per-boundary _merge_fingerprint cache (None = not computed
+        #: this boundary, False = kernel failed) shared by the window
+        #: merge and the merge-before-spill pass — ONE dispatch serves
+        #: both (docs/drain_pipeline.md)
+        self._fp_boundary = None
+        #: deferred retire/materialize ring of the explore in progress
+        #: (laser/retire_ring.py); None between explores
+        self._ring = None
+        #: materialize() bumps stats off-thread under MTPU_MAT_WORKERS>1
+        self._stats_lock = threading.Lock()
 
     def _full_bucket(self) -> int:
         """Full-width seed bucket for backlog drains, kept strictly
@@ -2173,17 +2368,22 @@ class LaneEngine:
 
     # -- materialization -----------------------------------------------------
 
-    def _obj(self, sid: int):
+    def _obj(self, sid: int, prov: Optional[dict] = None):
         """Object for a retired-row sid: positive sids index the table;
         negative sids are this window's provisional records, resolved
         through the drain's (lane, slot) map (the device-side remap only
         lands at the NEXT window's dispatch — retired rows are pulled
-        before that)."""
+        before that). `prov` is an explicit snapshot of that map for
+        ring-deferred materialization: the next drain REPLACES
+        self._prov, and a chunk materializing after that boundary (a
+        worker-pool build, or a deep ring) must resolve against the
+        map of the window it retired in."""
         if sid > 0:
             return self.objects[sid]
         d_recs = self.lane_kwargs.get("dlog_records", 64)
         idx = -sid - 1
-        return self.objects[self._prov[(idx // d_recs, idx % d_recs)]]
+        table = self._prov if prov is None else prov
+        return self.objects[table[(idx // d_recs, idx % d_recs)]]
 
     def _try_resume(self, rows: dict, i: int, byte_pc: int, sp: int
                     ) -> Optional[tuple]:
@@ -2292,9 +2492,12 @@ class LaneEngine:
                 sid, limbs)
 
     def materialize(self, st_host: dict, lane: int,
-                    ctx: LaneCtx) -> GlobalState:
+                    ctx: LaneCtx,
+                    prov: Optional[dict] = None) -> GlobalState:
         """Rebuild a host GlobalState for a parked lane. `st_host` is a
-        device_get of the SymLaneState."""
+        device_get of the SymLaneState; `prov` is an optional snapshot
+        of the provisional-sid map for ring-deferred builds (see
+        _obj)."""
         # copy(), not deepcopy() — interpreter-fork sharing semantics;
         # per-lane Account/Storage instances keep mutations independent
         gs = copy(ctx.template)
@@ -2339,7 +2542,7 @@ class LaneEngine:
         for s in range(sp):
             sid = int(st_host["ssid"][lane, s])
             if sid:
-                ms.stack.append(self._obj(sid))
+                ms.stack.append(self._obj(sid, prov))
             else:
                 ms.stack.append(
                     _bv_val(_limbs_int(st_host["stack"][lane, s])))
@@ -2364,7 +2567,8 @@ class LaneEngine:
             for r in range(int(st_host["mlog_count"][lane])):
                 off = int(st_host["mlog_off"][lane, r])
                 ln = int(st_host["mlog_len"][lane, r])
-                obj = self._obj(int(st_host["mlog_sid"][lane, r]))
+                obj = self._obj(int(st_host["mlog_sid"][lane, r]),
+                                prov)
                 for j in range(ln):
                     sym_cover[off + j] = (obj, j)
             for i in np.nonzero(kind)[0]:
@@ -2391,7 +2595,7 @@ class LaneEngine:
         entries = []
         for r in range(scount):
             sidk = int(st_host["skey_sid"][lane, r])
-            key = alu.to_bitvec(self._obj(sidk)) if sidk else \
+            key = alu.to_bitvec(self._obj(sidk, prov)) if sidk else \
                 _bv_val(_limbs_int(st_host["skeys"][lane, r]))
             entries.append((
                 key,
@@ -2405,7 +2609,7 @@ class LaneEngine:
 
         def _sval(r, sid):
             if sid:
-                return self._obj(sid)
+                return self._obj(sid, prov)
             return _bv_val(_limbs_int(st_host["svals"][lane, r]))
 
         if not any(e[6] for e in entries):
@@ -2458,7 +2662,10 @@ class LaneEngine:
         # states are eligible again)
         gs._lane_parked_pc = ms.pc
 
-        self.stats["parked"] += 1
+        # guarded: ring workers (MTPU_MAT_WORKERS>1) materialize off
+        # the engine thread, and `+= 1` is not GIL-atomic
+        with self._stats_lock:
+            self.stats["parked"] += 1
         return gs
 
     # -- per-explore memo hygiene --------------------------------------------
@@ -2758,32 +2965,73 @@ class LaneEngine:
             pre.setdefault(key, []).append(lane)
         if not any(len(v) > 1 for v in pre.values()):
             return
-        d_recs = self.lane_kwargs.get("dlog_records", 64)
-        n = self.n_lanes
-        pv = min(PROV_BUCKET, n * d_recs) \
-            if len(self._prov) <= PROV_BUCKET else n * d_recs
-        prov_pairs = np.full((pv, 2), n * d_recs, np.int32)
-        for j, ((lane, slot), oid) in enumerate(self._prov.items()):
-            prov_pairs[j, 0] = lane * d_recs + slot
-            prov_pairs[j, 1] = oid
-        try:
-            with _prof("merge_fp"), \
-                    trace.span("merge.fingerprint",
-                               groups=len(pre)):
-                fp = np.asarray(jax.device_get(_merge_fingerprint(
-                    st, jnp.asarray(prov_pairs))))
-        except Exception as e:  # a screen, never an error path
-            log.debug("merge fingerprint failed: %s", e)
+        fp = self._boundary_fp(st, groups=len(pre))
+        if fp is None:
             return
-        # gas-widening merge (MTPU_MERGE_GASWIDEN, default on): with
-        # widening OFF the gas interval joins the exact twin key (the
-        # historical behavior — uneven-gas arms never merge); with it
-        # ON, arms group gas-blind and the survivor's ctx gas offsets
-        # widen to cover every dropped arm, a sound interval
-        # over-approximation (docs/lane_merge.md)
+        merged, subsumed, widened, dropped = \
+            self._collapse_twins(pre, fp, ctxs)
+        kill.extend(dropped)
+        if merged or subsumed:
+            self.stats["lanes_merged"] += merged
+            self.stats["lanes_subsumed"] += subsumed
+            self.stats["merge_rounds"] += 1
+            self.stats["gas_widened"] = (
+                self.stats.get("gas_widened", 0) + widened)
+            from ..smt.solver.solver_statistics import SolverStatistics
+
+            SolverStatistics().bump(
+                lanes_merged=merged, lanes_subsumed=subsumed,
+                merge_rounds=1, gas_widened_lanes=widened)
+            merge_mod.note_retired(merged + subsumed)
+            trace.event("merge.window", merged=merged,
+                        subsumed=subsumed)
+            log.info("lane merge: %d merged, %d subsumed at window "
+                     "boundary", merged, subsumed)
+
+    def _boundary_fp(self, st, groups: int = 0):
+        """Per-lane frontier fingerprint for THIS window boundary
+        (_merge_fingerprint over the full plane), computed at most once
+        and shared by the live-lane window merge AND the
+        merge-before-spill pass — the two passes cost ONE dispatch
+        between them. None on kernel failure (both passes then skip —
+        a screen, never an error path). The cache resets at every
+        window (explore loop)."""
+        if self._fp_boundary is None:
+            d_recs = self.lane_kwargs.get("dlog_records", 64)
+            n = self.n_lanes
+            pv = min(PROV_BUCKET, n * d_recs) \
+                if len(self._prov) <= PROV_BUCKET else n * d_recs
+            prov_pairs = np.full((pv, 2), n * d_recs, np.int32)
+            for j, ((lane, slot), oid) in enumerate(self._prov.items()):
+                prov_pairs[j, 0] = lane * d_recs + slot
+                prov_pairs[j, 1] = oid
+            try:
+                with _prof("merge_fp"), \
+                        trace.span("merge.fingerprint", groups=groups):
+                    self._fp_boundary = np.asarray(
+                        jax.device_get(_merge_fingerprint(
+                            st, jnp.asarray(prov_pairs))))
+            except Exception as e:  # a screen, never an error path
+                log.debug("merge fingerprint failed: %s", e)
+                self._fp_boundary = False
+        return None if self._fp_boundary is False else self._fp_boundary
+
+    def _collapse_twins(self, pre, fp, ctxs):
+        """Shared twin-collapse body of the window merge and the
+        merge-before-spill pass: within each host pre-group, lanes
+        whose device fingerprints match hand their condition lists to
+        merge.plan_group; the survivor's ctx takes the OR'd suffix
+        (and, under MTPU_MERGE_GASWIDEN, gas offsets widened to the
+        group hull — gas-widening merge, docs/lane_merge.md: with
+        widening OFF the gas interval joins the exact twin key, the
+        historical behavior). Returns (merged, subsumed, widened,
+        dropped lane list)."""
+        from . import merge as merge_mod
+
         gas_widen = merge_mod.gas_widen_enabled()
         merged = subsumed = widened = 0
-        for key, lanes in pre.items():
+        dropped_lanes: List[int] = []
+        for _key, lanes in pre.items():
             if len(lanes) < 2:
                 continue
             twins: Dict[tuple, List[int]] = {}
@@ -2830,27 +3078,116 @@ class LaneEngine:
                         ctxs[survivor].gas0_max += dmax
                         widened += len(plan.dropped)
                 for mi, reason in plan.dropped.items():
-                    kill.append(group[mi])
+                    dropped_lanes.append(group[mi])
                     if reason == "merged":
                         merged += 1
                     else:
                         subsumed += 1
-        if merged or subsumed:
-            self.stats["lanes_merged"] += merged
-            self.stats["lanes_subsumed"] += subsumed
-            self.stats["merge_rounds"] += 1
-            self.stats["gas_widened"] = (
-                self.stats.get("gas_widened", 0) + widened)
+        return merged, subsumed, widened, dropped_lanes
+
+    def _spill_merge(self, st, lanes, ctxs, dead_set, counts_h) -> set:
+        """Merge-before-spill (docs/drain_pipeline.md): the window's
+        retired SPILL CANDIDATES — parked lanes about to materialize
+        into the host worklist — run the same fingerprint twin-collapse
+        the live-lane merge runs, BEFORE any GlobalState is built. A
+        rejoin twin that would have merged at the next dispatch instead
+        re-executed host-side in the spill/refill regime (one
+        interpreter step + re-seed + full device re-execution per twin,
+        every spill generation); collapsing it here is why the overflow
+        regime stops paying rejoin storms twice. The dropped lanes are
+        already DEAD on device (the retire gather marked them); they
+        are simply never materialized, and the survivor materializes
+        with the OR'd constraint suffix (witness re-concretization
+        preserved — the same soundness argument as docs/lane_merge.md).
+        Returns the dropped-lane set. Gated by MTPU_MERGE +
+        MTPU_STREAM (merge.spill_merge_enabled)."""
+        from . import merge as merge_mod
+
+        if not merge_mod.spill_merge_enabled():
+            return set()
+        pcs, sps = counts_h["pc"], counts_h["sp"]
+        pre: Dict[tuple, List[int]] = {}
+        for lane in lanes:
+            ctx = ctxs[lane]
+            if ctx is None or lane in dead_set or ctx.promos:
+                continue
+            key = (
+                id(ctx.template), int(pcs[lane]), int(sps[lane]),
+                int(counts_h["msize"][lane]),
+                int(counts_h["scount"][lane]),
+                int(counts_h["mlog_count"][lane]),
+                tuple((k.raw.tid, v.raw.tid) for k, v in ctx.swrites),
+            )
+            pre.setdefault(key, []).append(lane)
+        if not any(len(v) > 1 for v in pre.values()):
+            return set()
+        fp = self._boundary_fp(st, groups=len(pre))
+        if fp is None:
+            return set()
+        merged, subsumed, widened, dropped = \
+            self._collapse_twins(pre, fp, ctxs)
+        if not dropped:
+            return set()
+        n = merged + subsumed
+        self.stats["spill_merged"] += n
+        self.stats["gas_widened"] = (
+            self.stats.get("gas_widened", 0) + widened)
+        from ..smt.solver.solver_statistics import SolverStatistics
+
+        SolverStatistics().bump(spill_merged_lanes=n,
+                                gas_widened_lanes=widened)
+        merge_mod.note_retired(n)
+        trace.event("retire.spill_merge", merged=merged,
+                    subsumed=subsumed)
+        log.info("merge-before-spill: %d of %d spill candidates "
+                 "collapsed at the window boundary", n, len(lanes))
+        return set(dropped)
+
+    # -- chunked escalation retire (docs/drain_pipeline.md) ------------------
+
+    def _retire_chunked(self, st, lanes_sel, retire_floors):
+        """The ONE sanctioned escalation-retire gather seam
+        (tools/lint_static.py rule "unbounded-retire-gather"): retiring
+        k lanes issues ceil(k/chunk) gathers of at most
+        MTPU_RETIRE_CHUNK rows each into bounded device buffers — live
+        width is no longer a single-allocation limit (the 64k-LIVE
+        kernel-fault shape, BENCH_r08). Chunk buckets are pow2 capped
+        at the chunk bound, so compile keys repeat across windows and
+        widths. Each chunk's D2H copy starts async at dispatch; a
+        deferred pull (the retire ring) overlaps the next window's
+        device execution. With chunking off (MTPU_RETIRE_CHUNK=0 or
+        MTPU_STREAM=0) this is bit-for-bit the old monolithic gather.
+        Returns (st, [(lanes, device rows, floors, dispatch time)])."""
+        ch = retire_chunk()
+        if ch <= 0 or len(lanes_sel) <= ch:
+            parts = [list(lanes_sel)]
+        else:
+            parts = [list(lanes_sel[i:i + ch])
+                     for i in range(0, len(lanes_sel), ch)]
+        cap = min(ch, self.n_lanes) if ch > 0 else self.n_lanes
+        chunks = []
+        for part in parts:
+            floors = retire_floors(part)
+            kp = _geo_bucket(len(part), cap, min(64, cap))
+            idx = np.full(kp, self.n_lanes, np.int32)
+            idx[: len(part)] = part
+            with _prof("retire_dispatch"):
+                st, rows = _retire_rows(st, jnp.asarray(idx), *floors)
+                for arr in rows:
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:
+                        break  # backend without async copies
+            chunks.append((part, rows, floors, time.perf_counter()))
+        if ch > 0:
+            self.stats["retire_chunks"] += len(parts)
             from ..smt.solver.solver_statistics import SolverStatistics
 
-            SolverStatistics().bump(
-                lanes_merged=merged, lanes_subsumed=subsumed,
-                merge_rounds=1, gas_widened_lanes=widened)
-            merge_mod.note_retired(merged + subsumed)
-            trace.event("merge.window", merged=merged,
-                        subsumed=subsumed)
-            log.info("lane merge: %d merged, %d subsumed at window "
-                     "boundary", merged, subsumed)
+            SolverStatistics().bump(retire_chunks=len(parts))
+            if len(parts) > 1:
+                trace.event("retire.chunked", lanes=len(lanes_sel),
+                            chunks=len(parts))
+        return st, chunks
 
     def live_seed_states(self) -> List[GlobalState]:
         """Host-only snapshot of every live lane as (seed template +
@@ -2860,10 +3197,23 @@ class LaneEngine:
         SIGTERM/fatal live dump can capture lanes mid-window
         (support/checkpoint.snapshot_live_states); the device progress
         since the seed re-executes on resume, and issue dedup absorbs
-        any re-detection. Empty when no explore is running."""
+        any re-detection. Empty when no explore is running.
+
+        Retired-but-unmaterialized lanes parked in the retire ring
+        (chunks whose pull is still deferred behind the next window)
+        are covered too: their ctxs ride the pending jobs'
+        introspection hook, so a SIGTERM mid-boundary loses no
+        in-flight subtree to the deferral."""
         ctxs = self._explore_ctxs
         if not ctxs:
             return []
+        ctxs = list(ctxs)
+        ring = self._ring
+        if ring is not None:
+            try:
+                ctxs.extend(ring.pending_ctx_sources())
+            except Exception:
+                pass  # best-effort, signal-safe
         out = []
         for ctx in list(ctxs):
             if ctx is None:
@@ -2880,7 +3230,7 @@ class LaneEngine:
 
     def _window_export(self, st, status, ctxs, dead_set, kill,
                        resumes, steps, free, results,
-                       retire_floors, padded_idx):
+                       retire_floors):
         """Mid-flight wave export at the window boundary
         (docs/checkpoint.md): when the export client asks for n lanes,
         the TAIL of the live set retires through the escalation gather
@@ -2910,14 +3260,21 @@ class LaneEngine:
             return st
         sel = live[len(live) - want:]
         try:
-            floors = retire_floors(sel)
+            # the export retires through the SAME chunked gather seam
+            # as the escalation retire (docs/drain_pipeline.md): a
+            # migration client asking for half a 64k wave must not
+            # recreate the single-allocation shape chunking removed
             with _prof("ckpt_export"), \
                     trace.span("ckpt.export", lanes=len(sel)):
-                st, rows = _retire_rows(
-                    st, jnp.asarray(padded_idx(sel)), *floors)
-                rows_host = _unpack_rows(jax.device_get(rows), *floors)
-                exported = [self.materialize(rows_host, row, ctxs[lane])
-                            for row, lane in enumerate(sel)]
+                st, chunks = self._retire_chunked(st, sel,
+                                                  retire_floors)
+                exported = []
+                for part, rows, floors, _t in chunks:
+                    rows_host = _unpack_rows(jax.device_get(rows),
+                                             *floors)
+                    exported.extend(
+                        self.materialize(rows_host, row, ctxs[lane])
+                        for row, lane in enumerate(part))
         except Exception as e:  # a seam, never an error path
             log.warning("mid-flight lane export failed (%s); lanes "
                         "stay local", e)
@@ -3018,38 +3375,61 @@ class LaneEngine:
             # only on narrow meshed engines
             small = max(self.n_lanes // 2, 1)
         peak_demand = len(queue)
-        # one-deep drain pipeline (double-buffered windows): window k's
-        # retire-row PULL and the GlobalState rebuilds for its retired
-        # lanes run AFTER window k+1 is dispatched, overlapping the
-        # host's biggest per-window costs (transfer + materialize) with
-        # device execution. Each entry is (rows, floors, items): rows
-        # is a host dict when already pulled or the device arrays of a
-        # deferred escalation retire (floors says how to unpack);
-        # items = [(row index, ctx)]. Flushed before window k+1's
-        # drain — materialize resolves this window's provisional sids
-        # through self._prov, which the next drain overwrites.
-        pending_mat: List[tuple] = []
+        # streaming retire/materialize pipeline
+        # (docs/drain_pipeline.md "streaming retire"): window k's
+        # retired lanes leave the device as bounded CHUNKS
+        # (_retire_chunked) whose D2H pulls and GlobalState rebuilds
+        # run AFTER window k+1 is dispatched — the host's biggest
+        # per-window costs (transfer + materialize) overlap device
+        # execution. The deferral structure is a bounded ring
+        # (laser/retire_ring.py) feeding a K-worker materialization
+        # pool (K=1 default: inline at flush, bit-identical to the old
+        # pending_mat list) with delivery order into `results` pinned
+        # to submit order. Each job snapshots this window's
+        # provisional-sid map — the next drain REPLACES self._prov.
+        from .retire_ring import RetireRing
 
-        def _flush_pending() -> None:
-            if not pending_mat:
-                return
-            t0 = time.perf_counter()
-            n_mat = 0
-            with trace.span("lane.materialize",
-                            waves=len(pending_mat)):
-                for rows_ref, floors, items in pending_mat:
-                    if floors is not None:  # deferred device rows
-                        with _prof("retire_pull"):
-                            rows_ref = _unpack_rows(
-                                jax.device_get(rows_ref), *floors)
-                    for row, ctx in items:
-                        results.append(
-                            self.materialize(rows_ref, row, ctx))
-                        n_mat += 1
-            self.stats["overlap_mat"] += n_mat
-            self.stats["overlap_mat_ms"] += int(
-                (time.perf_counter() - t0) * 1000)
-            pending_mat.clear()
+        ring = RetireRing(workers=mat_workers(), sink=results)
+        self._ring = ring
+        from ..smt.solver.solver_statistics import SolverStatistics \
+            as _SS
+
+        def _submit_mat(rows_ref, floors, items, t_disp) -> None:
+            """Queue one retired chunk: rows_ref is a host dict when
+            already pulled (floors None) or the device arrays of a
+            deferred gather; items = [(row index, ctx snapshot)]."""
+            prov = self._prov
+
+            def pull():
+                if floors is None:
+                    return rows_ref
+                t0 = time.perf_counter()
+                hidden_ms = (t0 - t_disp) * 1000.0
+                with self._stats_lock:
+                    # wall the D2H copy had to progress behind the
+                    # next window's execution before anyone blocked
+                    # on it — the measured hide of the deferred pull
+                    self.stats["retire_overlap_ms"] += hidden_ms
+                _SS().bump(retire_overlap_ms=hidden_ms)
+                with _prof("retire_pull"), \
+                        trace.span("retire.pull", rows=len(items)):
+                    return _unpack_rows(jax.device_get(rows_ref),
+                                        *floors)
+
+            def build(rows_host):
+                t0 = time.perf_counter()
+                with trace.span("retire.materialize", n=len(items)):
+                    out = [self.materialize(rows_host, row, ctx,
+                                            prov=prov)
+                           for row, ctx in items]
+                with self._stats_lock:
+                    self.stats["overlap_mat"] += len(items)
+                    self.stats["overlap_mat_ms"] += int(
+                        (time.perf_counter() - t0) * 1000)
+                return out
+
+            build.ring_items = items  # SIGTERM live-dump introspection
+            ring.submit(pull, build)
 
         # overlapped fork-feasibility screening (batched discharge,
         # gated like the host's fork pruning): queries collected at
@@ -3074,6 +3454,9 @@ class LaneEngine:
                     code_len=len(code_bytes))
         try:
             while True:
+                # per-boundary fingerprint cache: the window merge and
+                # the merge-before-spill pass share ONE dispatch
+                self._fp_boundary = None
                 # a seed backlog beyond the small bucket drains in ONE
                 # window through the full-width midpath variant — but only
                 # once that variant is compiled (warm_variant kicks a
@@ -3138,7 +3521,7 @@ class LaneEngine:
                 # executes, pull+rebuild the LAST window's retired
                 # GlobalStates and discharge its fork-feasibility batch
                 t_busy0 = time.perf_counter()
-                _flush_pending()
+                ring.flush()
                 if screen_future is not None:
                     # started at the previous drain: with the pool
                     # parallel the verdicts are usually already done
@@ -3300,13 +3683,6 @@ class LaneEngine:
                                     lk.get("storage_slots", 64), 8),
                     )
 
-                def _padded_idx(lanes_sel):
-                    kp = _geo_bucket(len(lanes_sel), self.n_lanes,
-                                     min(64, self.n_lanes))
-                    idx_arr = np.full(kp, self.n_lanes, np.int32)
-                    idx_arr[: len(lanes_sel)] = lanes_sel
-                    return idx_arr
-
                 def _materialize_rows(lanes_sel, rows_host):
                     with _prof("materialize"):
                         for row, lane in enumerate(lanes_sel):
@@ -3319,37 +3695,25 @@ class LaneEngine:
                             free.append(lane)
                     status[np.asarray(lanes_sel, np.int32)] = DEAD
 
-                def _defer_rows(lanes_sel, rows_ref, floors_sel):
-                    """Queue retired lanes for the pipelined flush: the
-                    slots free NOW (the device already marked the rows
-                    DEAD before any later dispatch can re-seed them);
-                    the row transfer + GlobalState rebuild run after
-                    the NEXT window is dispatched. ctx refs snapshot
-                    here — the slot may be re-seeded before the flush."""
-                    items = []
-                    for row, lane in enumerate(lanes_sel):
-                        self.stats["device_steps"] += int(steps[lane])
-                        if lane not in dead_set:
-                            items.append((row, ctxs[lane]))
-                        ctxs[lane] = None
-                        free.append(lane)
-                    status[np.asarray(lanes_sel, np.int32)] = DEAD
-                    pending_mat.append((rows_ref, floors_sel, items))
-
-                rows = None
+                rest_chunks = []
                 if rest:
-                    floors = _retire_floors(rest)
-                    with _prof("retire_dispatch"):
-                        st, rows = _retire_rows(
-                            st, jnp.asarray(_padded_idx(rest)), *floors)
-                        for arr in rows:
-                            try:
-                                arr.copy_to_host_async()
-                            except Exception:
-                                pass  # backend without async copies
+                    st, rest_chunks = self._retire_chunked(
+                        st, rest, _retire_floors)
 
                 self._prov, dead = self._drain_host(recs, forks, ctxs)
                 dead_set = set(dead)
+
+                # merge-before-spill (docs/drain_pipeline.md): the
+                # retired spill candidates — fast + escalation sets,
+                # now with their condition lists final — collapse
+                # exact-frontier twins BEFORE any GlobalState is
+                # built; dropped twins are never materialized, so the
+                # spill/refill regime stops re-executing rejoins it
+                # would have merged at the next dispatch
+                spill_dropped: set = set()
+                if fast or rest:
+                    spill_dropped = self._spill_merge(
+                        st, fast + rest, ctxs, dead_set, counts_h)
 
                 # in-place resume (needs self._prov): patches ride the
                 # next dispatch's seed buffer — zero extra round trips.
@@ -3381,33 +3745,47 @@ class LaneEngine:
                     st_fast = _unpack_rows((r_i32, r_u32, r_u8),
                                            *RETIRE_FLOORS)
                     with _prof("materialize"):
+                        items = []
                         for row, lane in enumerate(fast):
                             self.stats["device_steps"] += int(steps[lane])
-                            if lane not in dead_set:
-                                pending_mat.append(
-                                    (st_fast, None,
-                                     [(row, ctxs[lane])]))
+                            if lane not in dead_set \
+                                    and lane not in spill_dropped:
+                                items.append((row, ctxs[lane]))
                             ctxs[lane] = None
                             free.append(lane)
-                if rest:
-                    # pipelined: the escalation rows' pull rides the
-                    # NEXT window's execution (the gather itself was
-                    # dispatched before the drain and is ordered ahead
-                    # of any re-seed by the st dependency chain)
-                    _defer_rows(rest, rows, floors)
+                        if items:
+                            _submit_mat(st_fast, None, items,
+                                        time.perf_counter())
+                for part, rows_ref, floors_c, t_disp in rest_chunks:
+                    # pipelined: each chunk's pull rides the NEXT
+                    # window's execution (the gathers were dispatched
+                    # before the drain and are ordered ahead of any
+                    # re-seed by the st dependency chain); slots free
+                    # NOW — the device already marked the rows DEAD.
+                    # ctx refs snapshot here: the slot may be
+                    # re-seeded before the ring delivers.
+                    items = []
+                    for row, lane in enumerate(part):
+                        self.stats["device_steps"] += int(steps[lane])
+                        if lane not in dead_set \
+                                and lane not in spill_dropped:
+                            items.append((row, ctxs[lane]))
+                        ctxs[lane] = None
+                        free.append(lane)
+                    status[np.asarray(part, np.int32)] = DEAD
+                    _submit_mat(rows_ref, floors_c, items, t_disp)
                 if declined:
                     # rare: held lanes the host would not resume
                     # (symbolic length, OOG, oversize, trivially-false
                     # path) retire through a supplementary dispatch —
                     # they must not stay held forever
-                    dfloors = _retire_floors(declined)
-                    with _prof("retire_pull"):
-                        st, drows = _retire_rows(
-                            st, jnp.asarray(_padded_idx(declined)),
-                            *dfloors)
-                        d_host = _unpack_rows(jax.device_get(drows),
-                                              *dfloors)
-                    _materialize_rows(declined, d_host)
+                    st, dchunks = self._retire_chunked(
+                        st, declined, _retire_floors)
+                    for part, drows, dfloors, _t in dchunks:
+                        with _prof("retire_pull"):
+                            d_host = _unpack_rows(
+                                jax.device_get(drows), *dfloors)
+                        _materialize_rows(part, d_host)
                 # 3. trivially-false lanes still RUNNING on device: kill
                 # them at the next dispatch (before it seeds anything) and
                 # recycle their slots after it. Their host status stays
@@ -3454,8 +3832,7 @@ class LaneEngine:
                 if self.export_client is not None:
                     st = self._window_export(
                         st, status, ctxs, dead_set, kill, resumes,
-                        steps, free, results, _retire_floors,
-                        _padded_idx)
+                        steps, free, results, _retire_floors)
                 # collect the NEXT overlapped screen batch: lanes that
                 # gained path conditions this window and are still
                 # running (their descendants subset-kill through the
@@ -3491,9 +3868,22 @@ class LaneEngine:
                 if not running and not queue:
                     break
             # the last window has no successor dispatch to hide behind
-            _flush_pending()
+            ring.flush()
         finally:
             self._explore_ctxs = None
+            self._ring = None
+            try:
+                # exception mid-sweep: pending ring chunks are
+                # deliberately NOT flushed (svm re-runs the entry
+                # states host-side) — just stop the workers and book
+                # the occupancy high-water mark
+                ring.close()
+                if ring.high_water > self.stats.get(
+                        "ring_high_water", 0):
+                    self.stats["ring_high_water"] = ring.high_water
+                _SS().bump_max(ring_high_water=ring.high_water)
+            except Exception:  # telemetry only
+                pass
             trace.end("lane.explore",
                       windows=self.stats["windows"]
                       - stats0.get("windows", 0))

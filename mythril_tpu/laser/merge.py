@@ -104,6 +104,27 @@ def gas_widen_enabled() -> bool:
         os.environ.get("MTPU_MERGE_GASWIDEN", "1") != "0"
 
 
+def spill_merge_enabled() -> bool:
+    """Merge-before-spill sub-gate (docs/drain_pipeline.md "streaming
+    retire"): run the window-boundary fingerprint twin-collapse over
+    the retired SPILL CANDIDATES before they materialize into the host
+    worklist, so the spill/refill regime stops re-executing rejoin
+    twins it would have merged at the next dispatch. Rides the merge
+    master gate (MTPU_MERGE) and the streaming-pipeline master gate
+    (lane_engine.stream_enabled / MTPU_STREAM); MTPU_SPILL_MERGE=0
+    switches just this pass off."""
+    if not enabled():
+        return False
+    try:
+        from .lane_engine import stream_enabled
+
+        if not stream_enabled():
+            return False
+    except Exception:  # pragma: no cover - lane path optional
+        return False
+    return os.environ.get("MTPU_SPILL_MERGE", "1") != "0"
+
+
 def propagate_abstractions_enabled() -> bool:
     """RECOMPUTE subsumption abstractions with a fresh
     ops/propagate.py fixpoint dispatch (MTPU_MERGE_PROPAGATE=1,

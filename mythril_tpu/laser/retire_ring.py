@@ -1,0 +1,186 @@
+"""Bounded retire/materialize ring (docs/drain_pipeline.md,
+"streaming retire").
+
+The lane engine's window boundary retires parked lanes as CHUNKED
+device gathers (laser/lane_engine.py `_retire_chunked`) whose D2H pulls
+and GlobalState rebuilds are deferred behind the next window's device
+execution. This module owns the deferral structure: a bounded ring of
+submitted chunks feeding a small materialization worker pool, with
+DELIVERY ORDER into the svm worklist guaranteed to be submit order
+regardless of worker count.
+
+Why a ring and not the old ad-hoc `pending_mat` list: the daemon-scale
+target (ROADMAP item 1) packs thousands of small contracts into wide
+windows whose terminal storms retire tens of thousands of lanes per
+boundary. An unbounded deferral list makes peak host memory
+proportional to the storm; the ring bounds it — when the ring is full,
+`submit` drains the OLDEST entry inline (backpressure: the device
+gather already happened, only its pull/rebuild lands early).
+
+Worker policy (`MTPU_MAT_WORKERS`):
+
+* **K=1 (the default — single-CPU container constraint, see
+  ROADMAP's perf-gate note):** no threads at all. Chunks queue at
+  submit and are pulled+materialized inline at `flush`, exactly where
+  the engine's old `_flush_pending` ran — behavior identical to the
+  pre-ring build, with the overlap coming from the `copy_to_host_async`
+  started at dispatch time (the PR-1 drain trick applied to the retire
+  side). The win on this box is overlap-bound; the structure is what
+  scales.
+* **K>=2:** worker threads pull and materialize chunks as they are
+  submitted (term interning flips to its thread-safe miss path via the
+  sanctioned `smt.terms.set_thread_safe_interning` helper — the same
+  seam the solver pool uses). Results are buffered per sequence number
+  and `flush` delivers them in submit order, so the worklist the svm
+  sees is IDENTICAL to the K=1 run's (tests/test_stream_retire.py
+  gates this).
+
+Failure policy: a job that raises is re-raised at flush time on the
+engine thread (the engine's existing explore-failure path then falls
+back to the host interpreter — degraded, never wrong)."""
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+#: default ring capacity (chunks). Each entry holds one retire chunk's
+#: device arrays + item list — at the default MTPU_RETIRE_CHUNK=1024
+#: and full plane caps that is a few MB per entry, so the default
+#: bounds deferred host memory to tens of MB at any width.
+DEFAULT_CAPACITY = 16
+
+
+def ring_capacity() -> int:
+    """MTPU_RETIRE_RING (chunks held before backpressure); min 1."""
+    try:
+        return max(1, int(os.environ.get("MTPU_RETIRE_RING",
+                                         str(DEFAULT_CAPACITY))))
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+class _Job:
+    __slots__ = ("seq", "pull", "build", "submitted_at", "result",
+                 "error", "done")
+
+    def __init__(self, seq: int, pull: Callable, build: Callable):
+        self.seq = seq
+        self.pull = pull          # () -> host rows payload
+        self.build = build        # payload -> List[GlobalState]
+        self.submitted_at = time.perf_counter()
+        self.result: Optional[list] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    def run(self) -> None:
+        try:
+            self.result = self.build(self.pull())
+        except BaseException as e:  # re-raised on the engine thread
+            self.error = e
+        finally:
+            self.done.set()
+
+
+class RetireRing:
+    """Bounded, order-preserving retire/materialize pipeline stage."""
+
+    def __init__(self, workers: int = 1,
+                 capacity: Optional[int] = None,
+                 sink: Optional[list] = None):
+        self.workers = max(1, int(workers))
+        self.capacity = capacity if capacity else ring_capacity()
+        #: delivery target (the engine's results list); flush() extends
+        #: it in submit order
+        self.sink = sink if sink is not None else []
+        self._pending: deque = deque()  # jobs awaiting delivery
+        self._seq = 0
+        self.high_water = 0
+        self._threads: List[threading.Thread] = []
+        self._queue: deque = deque()    # jobs awaiting a worker (K>=2)
+        self._cv = threading.Condition()
+        self._shutdown = False
+        if self.workers > 1:
+            # worker materialization interns terms concurrently with
+            # the engine thread's drain: flip the interning miss path
+            # to its serialized mode (idempotent, process-wide)
+            from ..smt import terms as T
+
+            T.set_thread_safe_interning(True)
+            for i in range(self.workers):
+                t = threading.Thread(target=self._worker,
+                                     name=f"retire-mat-{i}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    # -- worker side (K>=2 only) --------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._queue:
+                    return
+                job = self._queue.popleft()
+            job.run()
+
+    # -- engine side ---------------------------------------------------------
+
+    def submit(self, pull: Callable, build: Callable) -> None:
+        """Queue one retired chunk for ordered delivery. When the ring
+        is full the OLDEST pending entry is delivered inline first
+        (bounded deferral; the overlap lost is one chunk's worth)."""
+        while len(self._pending) >= self.capacity:
+            self._deliver_one()
+        job = _Job(self._seq, pull, build)
+        self._seq += 1
+        self._pending.append(job)
+        self.high_water = max(self.high_water, len(self._pending))
+        if self.workers > 1:
+            with self._cv:
+                self._queue.append(job)
+                self._cv.notify()
+
+    def _deliver_one(self) -> None:
+        job = self._pending.popleft()
+        if self.workers > 1:
+            job.done.wait()
+        else:
+            job.run()
+        if job.error is not None:
+            raise job.error
+        self.sink.extend(job.result or ())
+
+    def flush(self) -> None:
+        """Deliver every pending chunk into the sink, in submit order.
+        The engine calls this in the overlapped phase after the next
+        window's dispatch (and once at explore end)."""
+        while self._pending:
+            self._deliver_one()
+
+    def pending_ctx_sources(self) -> list:
+        """Best-effort introspection for the SIGTERM live dump
+        (lane_engine.live_seed_states): the `build` closures of pending
+        jobs expose their (row, ctx) item lists via a `ring_items`
+        attribute when the engine attached one. Signal-safe: reads
+        only."""
+        out = []
+        for job in list(self._pending):
+            items = getattr(job.build, "ring_items", None)
+            if items:
+                out.extend(ctx for _row, ctx in items if ctx is not None)
+        return out
+
+    def close(self) -> None:
+        """Stop the worker threads (pending jobs are NOT delivered —
+        call flush first)."""
+        if self.workers > 1:
+            with self._cv:
+                self._shutdown = True
+                self._cv.notify_all()

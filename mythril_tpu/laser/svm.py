@@ -831,6 +831,19 @@ class LaserEVM:
                 log.warning(
                     "lane engine failed (%s); continuing host-side", e)
                 self.work_list.extend(states)
+                # capacity autoprobe (docs/drain_pipeline.md): on the
+                # first kernel-fault fallback, bisect the max stable
+                # live width once and clamp pick_width (persisted via
+                # cost_model into stats.json) — subsequent sweeps and
+                # runs degrade through spill/refill instead of
+                # re-faulting. A width that re-probes clean clamps
+                # nothing (transient failure, not capacity).
+                try:
+                    from .lane_engine import note_kernel_fault
+
+                    note_kernel_fault(width)
+                except Exception:
+                    pass
                 continue
             if static_mask is not None:
                 # host-side twin of the window-boundary retire: parked

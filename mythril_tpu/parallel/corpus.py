@@ -271,9 +271,13 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     # the perfect-balance share are pre-declared splittable so the
     # migration bus sheds their waves aggressively
     # (parallel/cost_model.py, docs/work_stealing.md)
-    from .cost_model import load_stats, make_shards
+    from .cost_model import load_stats, load_width_clamp, make_shards
 
     stats = load_stats(out)
+    # capacity-autoprobe warm start: a width that kernel-faulted a
+    # prior run over this --out-dir clamps pick_width from the first
+    # sweep (lane_engine.capacity_clamp consults cost_model)
+    load_width_clamp(out)
     shards, splittable = make_shards(paths, num_processes, stats)
     shard = shards[process_id]
     if bus is not None:

@@ -78,6 +78,36 @@ def record_host_peak(code_obj, peak: int) -> None:
     if code and peak > HOST_PEAKS.get(code, 0):
         HOST_PEAKS[code] = peak
 
+#: live-width clamp discovered by the lane engine's capacity autoprobe
+#: (lane_engine.note_kernel_fault): the largest plane width that
+#: probed stable after a kernel-fault fallback. Persisted into
+#: stats.json beside the cost model so subsequent runs (and the future
+#: daemon's schedulers) clamp pick_width instead of re-faulting.
+WIDTH_CLAMP: Optional[int] = None
+
+
+def record_width_clamp(width: int) -> None:
+    """Record an autoprobe clamp (running min — a tighter bound from
+    any source wins)."""
+    global WIDTH_CLAMP
+    if width and (WIDTH_CLAMP is None or width < WIDTH_CLAMP):
+        WIDTH_CLAMP = int(width)
+
+
+def load_width_clamp(out_dir) -> Optional[int]:
+    """Seed WIDTH_CLAMP from a prior run's stats.json (corpus warm
+    start — called beside load_stats). Returns the clamp in force."""
+    path = Path(out_dir) / STATS_NAME
+    try:
+        if path.exists():
+            clamp = json.loads(path.read_text()).get("lane_width_clamp")
+            if clamp:
+                record_width_clamp(int(clamp))
+    except Exception as e:  # pragma: no cover - warm start best-effort
+        log.debug("width-clamp load failed: %s", e)
+    return WIDTH_CLAMP
+
+
 STATS_NAME = "stats.json"
 
 #: wall-time EMA weight for the newest observation
@@ -134,6 +164,21 @@ def save_stats(out_dir, results: Sequence[dict],
         except Exception:
             telemetry = None
     payload = {"version": 1, "contracts": prior}
+    # capacity-autoprobe clamp (running min over prior runs): the
+    # engine side reads it back through load_width_clamp/WIDTH_CLAMP
+    # so a width that faulted once never faults this fleet again
+    prior_clamp = None
+    try:
+        old = Path(out) / STATS_NAME
+        if old.exists():
+            prior_clamp = json.loads(old.read_text()).get(
+                "lane_width_clamp")
+    except Exception:
+        prior_clamp = None
+    clamp = min((c for c in (prior_clamp, WIDTH_CLAMP) if c),
+                default=None)
+    if clamp:
+        payload["lane_width_clamp"] = int(clamp)
     if telemetry:
         payload["telemetry"] = telemetry
     try:

@@ -457,6 +457,14 @@ def snapshot_live_states(laser) -> list:
     conditions), pure host work that is safe from a signal handler
     (no device access; a lane's progress since its seed re-executes
     on resume, restricted to its recorded branch by the conditions).
+    Lanes retired into the streaming retire ring but not yet
+    materialized (chunks whose deferred pull is still riding the next
+    window — docs/drain_pipeline.md §1b) are covered by the same
+    seed-state rebuild: live_seed_states reads their ctx snapshots
+    off the pending ring jobs, so the deferral loses no subtree.
+    The mid-flight window-export client itself retires through the
+    chunked gather seam (LaneEngine._retire_chunked), so a migration
+    split of a 64k wave never recreates the monolithic allocation.
     Best-effort per state: a state that fails to rebuild is dropped
     (it re-runs from the round checkpoint instead)."""
     states = list(getattr(laser, "work_list", ()) or ())

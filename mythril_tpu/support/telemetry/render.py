@@ -90,6 +90,13 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("shipped", "verdicts_shipped"),
         ("replayed", "verdicts_replayed"),
     )),
+    ("Streaming retire", "docs/drain_pipeline.md",
+     ("retire_chunks", "spill_merged_lanes"), (
+        ("chunks", "retire_chunks"),
+        ("pull_overlap_ms", "retire_overlap_ms"),
+        ("spill_merged", "spill_merged_lanes"),
+        ("ring_high_water", "ring_high_water"),
+    )),
     ("Checkpoint/resume", "docs/checkpoint.md",
      ("lanes_exported", "lanes_imported", "midflight_steals",
       "resume_rounds"), (

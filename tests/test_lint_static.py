@@ -249,3 +249,35 @@ def test_raw_pickle_allowlist_suppresses(tmp_path):
     finally:
         lint_static.REPO, lint_static.ALLOWLIST = old_repo, old_allow
     assert findings == []
+
+
+def test_retire_gather_outside_seam_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/bad_ret.py", """\
+        def drain(st, lanes):
+            st, rows = _retire_rows(st, lanes, 8, 64, 8, 8)
+            return st
+    """)
+    assert [f.rule for f in findings] == ["unbounded-retire-gather"]
+    assert findings[0].line == 2
+
+
+def test_retire_gather_in_sanctioned_seam_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/good_ret.py", """\
+        def _retire_chunked(self, st, lanes_sel, retire_floors):
+            for part in [lanes_sel]:
+                st, rows = _retire_rows(st, part, 8, 64, 8, 8)
+            return st
+
+        def _probe_width(width, lane_kwargs=None):
+            st, rows = _retire_rows(None, None, 8, 64, 8, 8)
+            return True
+    """)
+    assert findings == []
+
+
+def test_retire_gather_outside_laser_ok(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/ops/elsewhere.py", """\
+        def foo(st):
+            return _retire_rows(st, None, 8, 64, 8, 8)
+    """)
+    assert findings == []

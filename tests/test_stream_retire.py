@@ -1,0 +1,414 @@
+"""Streaming retire/materialize pipeline (docs/drain_pipeline.md,
+"streaming retire"): chunked escalation gathers are bit-identical to
+the monolithic path on randomized lane mixes, the retire ring delivers
+in deterministic order under K=1 and K=2 workers, merge-before-spill
+collapses rejoin twins on an overflow storm with issue identity, the
+MTPU_RETIRE_CHUNK=0 off-switch is really off, and the capacity
+autoprobe clamps pick_width (persisted via cost_model) after a
+kernel-fault fallback."""
+
+import json
+import logging
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+from mythril_tpu.orchestration.mythril_disassembler import (
+    MythrilDisassembler,
+)
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+from mythril_tpu.support.support_args import args as global_args
+
+OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+
+def _push(v, n=1):
+    return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+
+def _fork_tree_code(k=5, sstore_every=1):
+    """k sequential symbolic branches -> 2^k paths, SSTORE on every
+    `sstore_every`-th level (varying the retire-row shapes)."""
+    c = bytearray(_push(0))
+    for i in range(k):
+        c += _push(i) + bytes([OP["CALLDATALOAD"]])
+        c += _push(1) + bytes([OP["AND"], OP["ISZERO"]])
+        j = len(c)
+        c += _push(0, 2) + bytes([OP["JUMPI"]])
+        c += _push(7) + bytes([OP["ADD"], OP["DUP1"]])
+        if i % sstore_every == 0:
+            c += _push(i) + bytes([OP["SSTORE"]])
+        else:
+            c += bytes([OP["POP"]])
+        c[j + 1:j + 3] = len(c).to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"]])
+    c += _push(0) + bytes([OP["SSTORE"], OP["STOP"]])
+    return bytes(c)
+
+
+def _diamond_code(k=5):
+    """k step/gas-balanced rejoining diamonds + an INVALID tail: the
+    exact-frontier-twin storm shape (every arm pair rejoins with an
+    identical frontier), with one reachable assert-style issue for
+    identity gating."""
+    c = bytearray()
+    for i in range(k):
+        c += _push(i) + bytes([OP["CALLDATALOAD"]])
+        c += _push(1) + bytes([OP["AND"]])
+        j = len(c)
+        c += _push(0, 2) + bytes([OP["JUMPI"]])
+        c += bytes([OP["JUMPDEST"]])
+        jf = len(c)
+        c += _push(0, 2) + bytes([OP["JUMP"]])
+        t = len(c)
+        c[j + 1:j + 3] = t.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"]])
+        jt = len(c)
+        c += _push(0, 2) + bytes([OP["JUMP"]])
+        r = len(c)
+        c[jf + 1:jf + 3] = r.to_bytes(2, "big")
+        c[jt + 1:jt + 3] = r.to_bytes(2, "big")
+        c += bytes([OP["JUMPDEST"]])
+    c += _push(31) + bytes([OP["CALLDATALOAD"]])
+    c += _push(0xDEADBEEF, 4) + bytes([OP["EQ"]])
+    j = len(c)
+    c += _push(0, 2) + bytes([OP["JUMPI"]])
+    c += bytes([OP["STOP"]])
+    c[j + 1:j + 3] = len(c).to_bytes(2, "big")
+    c += bytes([OP["JUMPDEST"], 0xFE])  # INVALID
+    return bytes(c)
+
+
+def _reset_modules():
+    from mythril_tpu.analysis.module.loader import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules(None, None):
+        m.reset_module()
+        m.cache.clear()
+
+
+def _analyze(code_hex, tpu_lanes):
+    _reset_modules()
+    disassembler = MythrilDisassembler(eth=None)
+    address, _ = disassembler.load_from_bytecode(code_hex,
+                                                 bin_runtime=True)
+    cmd_args = SimpleNamespace(
+        execution_timeout=600, max_depth=4096, solver_timeout=25000,
+        no_onchain_data=True, loop_bound=3, create_timeout=10,
+        pruning_factor=None, unconstrained_storage=False,
+        parallel_solving=False, call_depth_limit=3,
+        disable_dependency_pruning=False, custom_modules_directory="",
+        solver_log=None, transaction_sequences=None,
+        tpu_lanes=tpu_lanes,
+    )
+    analyzer = MythrilAnalyzer(
+        disassembler=disassembler, cmd_args=cmd_args, strategy="bfs",
+        address=address,
+    )
+    try:
+        report = analyzer.fire_lasers(modules=None, transaction_count=1)
+    finally:
+        global_args.tpu_lanes = 0
+    out = json.loads(report.as_json())
+    for issue in out.get("issues") or []:
+        issue.pop("discoveryTime", None)
+    return sorted(out.get("issues") or [],
+                  key=lambda i: json.dumps(i, sort_keys=True))
+
+
+def _sig(issues):
+    """Issue-SET signature for comparisons ACROSS merge gates: a
+    merged OR constraint may re-concretize a different (equally valid)
+    witness disjunct, so tx-data/description details can differ while
+    the issue set must not (the documented MTPU_MERGE contract,
+    PARITY.md). Same-gate comparisons keep full-JSON identity."""
+    return sorted((i.get("swc-id"), i.get("severity"),
+                   i.get("address"), i.get("title")) for i in issues)
+
+
+@pytest.fixture
+def stream_env(monkeypatch):
+    """Restore every stream override after each test."""
+    from mythril_tpu.laser import lane_engine
+
+    monkeypatch.setattr(lane_engine, "FORCE_STREAM", None)
+    monkeypatch.setattr(lane_engine, "FORCE_RETIRE_CHUNK", None)
+    yield monkeypatch
+
+
+def _run_lane(code, width, monkeypatch, chunk=None, workers=None,
+              stream=None, spill_merge=None):
+    """One lane analysis under the given stream knobs; returns
+    (issues, engine stats delta, solver-counter delta)."""
+    from mythril_tpu.laser import lane_engine
+
+    # set both overrides unconditionally: each run is self-contained
+    # (None = env default), so a stream=False run never leaks into a
+    # later call in the same test
+    monkeypatch.setattr(lane_engine, "FORCE_RETIRE_CHUNK", chunk)
+    monkeypatch.setattr(lane_engine, "FORCE_STREAM", stream)
+    if workers is not None:
+        monkeypatch.setenv("MTPU_MAT_WORKERS", str(workers))
+    if spill_merge is not None:
+        monkeypatch.setenv("MTPU_SPILL_MERGE", spill_merge)
+    ss = SolverStatistics()
+    c0 = dict(ss.batch_counters())
+    lane_engine.RUN_STATS_TOTAL = {}
+    monkeypatch.setattr(lane_engine, "FORCE_WIDTH", width)
+    lane_engine.PATH_HISTORY[code] = 256
+    try:
+        issues = _analyze(code.hex(), width)
+    finally:
+        monkeypatch.setattr(lane_engine, "FORCE_WIDTH", None)
+    c1 = ss.batch_counters()
+    delta = {k: round(c1[k] - c0.get(k, 0), 1)
+             for k, v in c1.items() if isinstance(v, (int, float))}
+    return issues, dict(lane_engine.RUN_STATS_TOTAL), delta
+
+
+# ---------------------------------------------------------------------------
+# chunked-vs-monolithic bit identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,sstore_every,width,chunk", [
+    (5, 1, 64, 4),    # 32 paths, RCAP(16) fast + 16 escalation, 4-chunks
+    (5, 2, 64, 8),    # mixed row shapes across chunks
+    (6, 1, 32, 4),    # overflow/spill regime: 64 paths through 32 lanes
+])
+def test_chunked_retire_bit_identity(stream_env, k, sstore_every,
+                                     width, chunk):
+    """Randomized lane mixes (fork trees of varying SSTORE density,
+    incl. the RCAP fast/escalation boundary and the spill regime)
+    produce IDENTICAL issues and path counts with chunked vs
+    monolithic retire — and the chunked run provably split gathers."""
+    code = _fork_tree_code(k=k, sstore_every=sstore_every)
+    mono_issues, mono_stats, _ = _run_lane(code, width, stream_env,
+                                           chunk=0)
+    chunk_issues, chunk_stats, chunk_delta = _run_lane(
+        code, width, stream_env, chunk=chunk)
+    assert chunk_stats.get("device_steps", 0) > 0, chunk_stats
+    assert chunk_issues == mono_issues
+    assert chunk_stats.get("parked", 0) == mono_stats.get("parked", 0)
+    assert chunk_stats.get("retire_chunks", 0) > 0
+    assert mono_stats.get("retire_chunks", 0) == 0
+
+
+def test_retire_chunk_off_switch_really_off(stream_env):
+    """MTPU_RETIRE_CHUNK=0 (and MTPU_STREAM=0) must take the historical
+    monolithic path: zero chunk-mode gathers booked anywhere, identical
+    issues."""
+    from mythril_tpu.laser import lane_engine
+
+    code = _fork_tree_code(k=5)
+    on_issues, _on_stats, _ = _run_lane(code, 64, stream_env, chunk=16)
+    off_issues, off_stats, off_delta = _run_lane(code, 64, stream_env,
+                                                 chunk=0)
+    assert off_issues == on_issues
+    assert off_stats.get("retire_chunks", 0) == 0
+    assert off_delta.get("retire_chunks", 0) == 0
+    # master gate: stream off forces chunking off too
+    assert lane_engine.retire_chunk() >= 0  # env-independent smoke
+    stream_env.setattr(lane_engine, "FORCE_STREAM", False)
+    assert lane_engine.retire_chunk() == 0
+    assert lane_engine.mat_workers() == 1
+
+
+def test_all_dead_chunks_deliver_empty(stream_env):
+    """A chunk whose every lane was collapsed before materialization
+    (merge-before-spill dropping whole rejoin-twin chunks) delivers an
+    empty item list without crashing, and the survivor set still
+    produces the full issue set (the diamond storm at chunk=2 makes
+    twin-only chunks overwhelmingly likely)."""
+    code = _diamond_code(k=5)
+    base_issues, _s, _d = _run_lane(code, 32, stream_env, chunk=0,
+                                    stream=False)
+    issues, stats, delta = _run_lane(code, 32, stream_env, chunk=4)
+    assert _sig(issues) == _sig(base_issues)  # across the merge gate
+    assert len(issues) > 0
+    assert stats.get("retire_chunks", 0) > 1
+
+
+# ---------------------------------------------------------------------------
+# merge-before-spill
+# ---------------------------------------------------------------------------
+
+
+def test_spill_merge_collapses_overflow_storm(stream_env):
+    """The rejoin-heavy overflow storm (2^5 diamond paths through an
+    8-lane engine — the spill/refill regime) books
+    ``spill_merged_lanes > 0`` with merge-before-spill on, and the
+    issue set is identical with the pass off (MTPU_SPILL_MERGE=0) and
+    with the whole merge layer off."""
+    from mythril_tpu.laser import merge as merge_mod
+
+    code = _diamond_code(k=5)
+    on_issues, on_stats, on_delta = _run_lane(code, 8, stream_env,
+                                              chunk=4)
+    off_issues, _off_stats, off_delta = _run_lane(
+        code, 8, stream_env, chunk=4, spill_merge="0")
+    merge_mod.FORCE = False
+    try:
+        nomerge_issues, _s, _d = _run_lane(code, 8, stream_env,
+                                           chunk=4)
+    finally:
+        merge_mod.FORCE = None
+    assert on_delta.get("spill_merged_lanes", 0) > 0, on_delta
+    assert off_delta.get("spill_merged_lanes", 0) == 0, off_delta
+    # across merge gates: issue-SET identity (witness disjuncts may
+    # re-concretize differently — the documented MTPU_MERGE contract)
+    assert _sig(on_issues) == _sig(off_issues) == _sig(nomerge_issues)
+    assert len(on_issues) > 0
+    # fewer states materialized into the host worklist with the pass on
+    assert on_stats.get("parked", 0) < _off_stats.get("parked", 0) \
+        or on_stats.get("spill_merged", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# retire ring: delivery-order determinism under K=1 / K=2
+# ---------------------------------------------------------------------------
+
+
+def test_ring_orders_delivery_across_workers():
+    """Unit: jobs completing out of order (a slow early job under K=2)
+    still deliver in submit order, the high-water mark tracks peak
+    occupancy, and errors re-raise on the engine thread."""
+    from mythril_tpu.laser.retire_ring import RetireRing
+
+    for workers in (1, 2):
+        sink = []
+        ring = RetireRing(workers=workers, capacity=8, sink=sink)
+        try:
+            for i in range(6):
+                delay = 0.05 if i == 0 and workers > 1 else 0.0
+
+                def pull(i=i, delay=delay):
+                    time.sleep(delay)
+                    return i
+
+                def build(payload, i=i):
+                    return [f"state-{i}-{payload}"]
+
+                ring.submit(pull, build)
+            ring.flush()
+        finally:
+            ring.close()
+        assert sink == [f"state-{i}-{i}" for i in range(6)], \
+            (workers, sink)
+        assert ring.high_water >= 1
+
+    # backpressure: capacity 2 drains the OLDEST inline at submit
+    sink = []
+    ring = RetireRing(workers=1, capacity=2, sink=sink)
+    for i in range(5):
+        ring.submit(lambda i=i: i, lambda p: [p])
+    assert sink == [0, 1, 2]  # 3 forced deliveries, 2 still pending
+    ring.flush()
+    assert sink == [0, 1, 2, 3, 4]
+    assert ring.high_water == 2
+
+    # error path: a failing build re-raises at delivery time
+    ring = RetireRing(workers=1, capacity=4, sink=[])
+
+    def boom(payload):
+        raise RuntimeError("materialize failed")
+
+    ring.submit(lambda: 1, boom)
+    with pytest.raises(RuntimeError):
+        ring.flush()
+
+
+def test_ring_workers_engine_identity(stream_env):
+    """End-to-end: MTPU_MAT_WORKERS=2 produces the same issues and the
+    same materialized-state count as K=1 on the fork storm (delivery
+    order into the worklist is pinned to submit order)."""
+    code = _fork_tree_code(k=5)
+    one_issues, one_stats, _ = _run_lane(code, 64, stream_env,
+                                         chunk=8, workers=1)
+    two_issues, two_stats, _ = _run_lane(code, 64, stream_env,
+                                         chunk=8, workers=2)
+    assert two_issues == one_issues
+    assert two_stats.get("parked", 0) == one_stats.get("parked", 0)
+
+
+# ---------------------------------------------------------------------------
+# capacity autoprobe
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def clean_autoprobe(monkeypatch):
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.parallel import cost_model
+
+    monkeypatch.setattr(lane_engine, "CAPACITY_CLAMP", None)
+    monkeypatch.setattr(lane_engine, "_FAULT_PROBED", False)
+    monkeypatch.setattr(lane_engine, "_CLAMP_WARNED", False)
+    monkeypatch.setattr(cost_model, "WIDTH_CLAMP", None)
+    yield monkeypatch
+
+
+def test_autoprobe_clamps_and_persists(clean_autoprobe, tmp_path,
+                                       caplog):
+    """A kernel fault at 4096 with a rigged probe stable only up to 512
+    must: bisect to 512, clamp pick_width (WARNING once), persist the
+    clamp through cost_model/stats.json, and warm-start a fresh
+    process state from the file."""
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.parallel import cost_model
+
+    probed = []
+
+    def fake_probe(width, lane_kwargs=None):
+        probed.append(width)
+        return width <= 512
+
+    clamp = lane_engine.note_kernel_fault(4096, probe=fake_probe)
+    assert clamp == 512
+    assert lane_engine.CAPACITY_CLAMP == 512
+    assert cost_model.WIDTH_CLAMP == 512
+    # the faulted width re-probes first (transient-failure screen)
+    assert probed[0] == 4096
+    # once per process: a second fault changes nothing
+    assert lane_engine.note_kernel_fault(8192, probe=fake_probe) == 512
+
+    with caplog.at_level(logging.WARNING,
+                         logger="mythril_tpu.laser.lane_engine"):
+        w1 = lane_engine.pick_width(4096, 1000)
+        w2 = lane_engine.pick_width(4096, 1000)
+    assert w1 == 512 and w2 == 512
+    warns = [r for r in caplog.records
+             if "capped" in r.getMessage()]
+    assert len(warns) == 1, "clamp must WARN exactly once"
+
+    # persistence round trip (stats.json via cost_model)
+    cost_model.save_stats(tmp_path, [{"contract": "a.sol.o",
+                                      "wall_s": 1.0}])
+    data = json.loads((tmp_path / "stats.json").read_text())
+    assert data["lane_width_clamp"] == 512
+    cost_model.WIDTH_CLAMP = None
+    assert cost_model.load_width_clamp(tmp_path) == 512
+
+
+def test_autoprobe_transient_failure_does_not_clamp(clean_autoprobe):
+    """A fallback whose width re-probes CLEAN is not a capacity fault:
+    no clamp, pick_width unchanged."""
+    from mythril_tpu.laser import lane_engine
+
+    assert lane_engine.note_kernel_fault(
+        4096, probe=lambda w, lk=None: True) is None
+    assert lane_engine.CAPACITY_CLAMP is None
+    assert lane_engine.pick_width(4096, 1000) == 4096
+
+
+def test_probe_width_runs_on_cpu(clean_autoprobe):
+    """The real probe (plane init + full-cap retire gather) runs clean
+    at a small width on the CPU backend — the shape the autoprobe
+    bisects with."""
+    from mythril_tpu.laser import lane_engine
+
+    assert lane_engine._probe_width(64) is True

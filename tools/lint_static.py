@@ -56,6 +56,18 @@ version/code-identity framing the sidecar format carries. The
 checkpoint module is the one sanctioned seam; new sites must route
 through it — or be explicitly allowlisted with a reason.
 
+Rule 6 — unbounded-retire-gather (the PR-11 64k-lane-wall class): a
+direct call to the escalation retire gather ``_retire_rows`` in
+``mythril_tpu/laser/`` outside the sanctioned seams
+(``LaneEngine._retire_chunked`` — the bounded-chunk path every
+escalation/export retire must route through — plus the warm-up and
+capacity-probe helpers, and the jit wrapper itself). A bare
+``_retire_rows(st, ridx, ...)`` sized by the caller re-creates the
+single-allocation shape that kernel-faulted 64k-wide LIVE windows
+(BENCH_r08): the gather's output buffer scales with the retire set,
+not with the chunk bound. New call sites must go through
+``_retire_chunked`` — or be explicitly allowlisted with a reason.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -132,6 +144,49 @@ _RULE4_ROOTS = ("mythril_tpu/parallel/",
 #: everywhere else in the package
 _RULE5_EXEMPT = "mythril_tpu/support/checkpoint.py"
 _PICKLE_CALLS = frozenset(("dump", "load", "dumps", "loads"))
+
+#: rule-6 scope + sanctioned enclosing functions: _retire_chunked IS
+#: the bounded seam; the warm-up compiles the variant, the capacity
+#: probe measures the fault shape deliberately (both gather at a
+#: fixed small bucket)
+_RULE6_ROOT = "mythril_tpu/laser/"
+_RULE6_SANCTIONED = frozenset(
+    ("_retire_chunked", "_warm_one_inner", "_probe_width"))
+
+
+def _is_retire_gather_call(node: ast.Call) -> bool:
+    """_retire_rows(...) / lane_engine._retire_rows(...)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "_retire_rows"
+    return isinstance(fn, ast.Attribute) and fn.attr == "_retire_rows"
+
+
+def _retire_gather_findings(rel: str, tree) -> List["Finding"]:
+    """Walk with an enclosing-function stack so sanctioned seams can
+    host the call and everything else cannot."""
+    out: List[Finding] = []
+
+    def walk(node, fname):
+        for child in ast.iter_child_nodes(node):
+            cname = fname
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                cname = child.name
+            if isinstance(child, ast.Call) \
+                    and _is_retire_gather_call(child) \
+                    and fname not in _RULE6_SANCTIONED:
+                out.append(Finding(
+                    rel, child.lineno, "unbounded-retire-gather",
+                    "direct _retire_rows call outside the bounded "
+                    "chunk seam (_retire_chunked): a caller-sized "
+                    "gather re-creates the 64k-lane single-allocation "
+                    "fault shape — route through _retire_chunked or "
+                    "allowlist with a reason"))
+            walk(child, cname)
+
+    walk(tree, "")
+    return out
 
 
 def _is_raw_pickle_call(node: ast.Call) -> bool:
@@ -268,6 +323,9 @@ def lint_file(path: Path) -> List[Finding]:
                     "steps corrupt wall intervals; use "
                     "time.monotonic(), or datetime for true "
                     "timestamps)"))
+
+    if rel.startswith(_RULE6_ROOT):
+        out.extend(_retire_gather_findings(rel, tree))
 
     if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
         for node in ast.walk(tree):
