@@ -1818,6 +1818,240 @@ def _smoke_taint():
     return result
 
 
+def build_loopsum_contract(unbounded=False):
+    """Two-function dispatcher for the loop-summary gate (stage 13,
+    docs/static_pass.md §loop summaries):
+
+    * ``fnL`` (0x1111aaaa): a pure counter loop — 12 iterations at a
+      constant bound by default, or bounded by ``calldataload(4)``
+      when ``unbounded`` (the attacker-tainted hull that fires
+      UnboundedLoopGas) — whose exit counter value is committed to
+      storage slot 1 (observable, and the SSTORE keeps the loop
+      region analysis-alive under the static retire screen);
+    * ``fnV`` (0x2222bbbb): an unprotected SELFDESTRUCT — the
+      deterministic issue both paths must report identically whether
+      the loop is summarized or unrolled."""
+    from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+    op = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+    def push(v, n=1):
+        return bytes([0x5F + n]) + v.to_bytes(n, "big")
+
+    c = bytearray()
+    c += push(0) + bytes([op["CALLDATALOAD"]])
+    c += push(224) + bytes([op["SHR"]])
+    patches = []
+    for sel in (0x1111AAAA, 0x2222BBBB):
+        c += bytes([op["DUP1"]]) + push(sel, 4) + bytes([op["EQ"]])
+        patches.append(len(c))
+        c += push(0, 2) + bytes([op["JUMPI"]])
+    c += bytes([op["STOP"]])  # fallback
+    # fnL: the counter loop
+    tl = len(c)
+    c += bytes([op["JUMPDEST"], op["POP"]])
+    if unbounded:
+        c += push(4) + bytes([op["CALLDATALOAD"]])  # bound (tainted)
+    c += push(0)                                    # counter
+    head = len(c)
+    c += bytes([op["JUMPDEST"]])
+    if unbounded:
+        # [b, i] -> DUP2 DUP2 LT: i < b
+        c += bytes([op["DUP2"], op["DUP2"], op["LT"]])
+    else:
+        # [i] -> DUP1 PUSH 12 GT: 12 > i == i < 12
+        c += bytes([op["DUP1"]]) + push(12) + bytes([op["GT"]])
+    c += bytes([op["ISZERO"]])
+    jp = len(c)
+    c += push(0, 2) + bytes([op["JUMPI"]])
+    c += push(1) + bytes([op["ADD"]]) + push(head, 2) + \
+        bytes([op["JUMP"]])
+    ex = len(c)
+    c[jp + 1:jp + 3] = ex.to_bytes(2, "big")
+    c += bytes([op["JUMPDEST"]]) + push(1) + bytes([op["SSTORE"]])
+    if unbounded:
+        c += bytes([op["POP"]])
+    c += bytes([op["STOP"]])
+    # fnV: the deterministic issue
+    tv = len(c)
+    c += bytes([op["JUMPDEST"], op["POP"], op["CALLER"],
+                op["SELFDESTRUCT"]])
+    for patch, target in zip(patches, (tl, tv)):
+        c[patch + 1:patch + 3] = target.to_bytes(2, "big")
+    return bytes(c)
+
+
+def _smoke_loopsum():
+    """Stage 13: the verified loop-summary gate (docs/static_pass.md
+    §loop summaries, MTPU_LOOPSUM).
+
+    The rigged counter-loop dispatcher (build_loopsum_contract) runs
+    with {AccidentallyKillable, ArbitraryStorage} gating:
+
+    * ``loop_summaries_verified > 0`` — the closed form proved by one
+      recorded solver query through batch.discharge;
+    * ``loops_summarized_lanes > 0`` AND ``unroll_iters_saved > 0``
+      on the LANE path (the device parked at the head instead of
+      unrolling) and ``unroll_iters_saved > 0`` on the host path;
+    * strictly fewer executed instructions than MTPU_LOOPSUM=0 on a
+      direct svm run (the avoided-work evidence — wall is not gated,
+      single-CPU container constraint);
+    * issue identity vs MTPU_LOOPSUM=0 on the lane AND host paths;
+    * off-really-off: every loop-summary counter zero with the gate
+      down;
+    * UnboundedLoopGas fires on the unbounded-taint variant (host
+      interpreter AND the lane drain adapter) and stays silent on the
+      constant-bounded loop."""
+    from mythril_tpu.analysis.static_pass import loop_summary as ls
+    from mythril_tpu.analysis.static_pass import memo as static_memo
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support.analysis_args import make_cmd_args
+
+    code = build_loopsum_contract()
+    code_unbounded = build_loopsum_contract(unbounded=True)
+    counters = ("loop_summaries_verified", "loop_summaries_rejected",
+                "loops_summarized_lanes", "unroll_iters_saved")
+    ss = SolverStatistics()
+
+    def analyze(contract, loopsum_on, tpu_lanes, modules):
+        ls.FORCE = loopsum_on
+        try:
+            reset_analysis_state()
+            static_memo.clear()
+            ls.reset_for_tests()
+            c0 = dict(ss.batch_counters())
+            dis = MythrilDisassembler(eth=None)
+            address, _ = dis.load_from_bytecode(contract.hex(),
+                                                bin_runtime=True)
+            analyzer = MythrilAnalyzer(
+                disassembler=dis,
+                cmd_args=make_cmd_args(execution_timeout=120,
+                                       tpu_lanes=tpu_lanes,
+                                       loop_bound=32),
+                strategy="bfs", address=address)
+            report = analyzer.fire_lasers(modules=list(modules),
+                                          transaction_count=1)
+            c1 = ss.batch_counters()
+            return {
+                "issues": sorted((i.swc_id, i.address, i.title)
+                                 for i in report.issues.values()),
+                "counters": {k: round(c1[k] - c0.get(k, 0), 1)
+                             for k in counters},
+            }
+        finally:
+            ls.FORCE = None
+
+    def exec_steps(loopsum_on):
+        """Executed-instruction count of a direct host svm run (the
+        strictly-fewer-work evidence)."""
+        from mythril_tpu.disassembler.disassembly import Disassembly
+        from mythril_tpu.laser.strategy.extensions.bounded_loops \
+            import BoundedLoopsStrategy
+        from mythril_tpu.laser.state.world_state import WorldState
+        from mythril_tpu.laser.svm import LaserEVM
+        from mythril_tpu.laser.transaction.concolic import (
+            execute_message_call,
+        )
+        from mythril_tpu.smt import symbol_factory
+
+        ls.FORCE = loopsum_on
+        static_memo.clear()
+        ls.reset_for_tests()
+        try:
+            laser = LaserEVM(requires_statespace=False,
+                             execution_timeout=60)
+            laser.extend_strategy(BoundedLoopsStrategy, loop_bound=32)
+            world_state = WorldState()
+            account = world_state.create_account(
+                address=0xAFFE, concrete_storage=True)
+            account.set_balance(10 ** 18)
+            account.code = Disassembly(code.hex())
+            laser.open_states = [world_state]
+            execute_message_call(
+                laser,
+                callee_address=symbol_factory.BitVecVal(0xAFFE, 256),
+                caller_address=symbol_factory.BitVecVal(0xACE, 256),
+                origin_address=symbol_factory.BitVecVal(0xACE, 256),
+                code=code.hex(),
+                data=list((0x1111AAAA).to_bytes(4, "big")),
+                gas_limit=8000000, gas_price=10, value=0,
+                track_gas=True)
+            return laser.total_states
+        finally:
+            ls.FORCE = None
+            static_memo.clear()
+
+    modules = ["AccidentallyKillable", "ArbitraryStorage"]
+    lane_engine.PATH_HISTORY[code] = 64
+    lane_engine.PATH_HISTORY[code_unbounded] = 64
+    lane_engine.FORCE_WIDTH = 64
+    old_window = lane_engine.DEFAULT_WINDOW
+    lane_engine.DEFAULT_WINDOW = 32
+    try:
+        lane_engine.warm_variant(
+            64, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        lane_off = analyze(code, False, 64, modules)
+        lane_on = analyze(code, True, 64, modules)
+        lane_unbounded = analyze(code_unbounded, True, 64,
+                                 ["UnboundedLoopGas"])
+    finally:
+        lane_engine.FORCE_WIDTH = None
+        lane_engine.DEFAULT_WINDOW = old_window
+    host_off = analyze(code, False, 0, modules)
+    host_on = analyze(code, True, 0, modules)
+    host_unbounded = analyze(code_unbounded, True, 0,
+                             ["UnboundedLoopGas"])
+    host_bounded_det = analyze(code, True, 0, ["UnboundedLoopGas"])
+    steps_on = exec_steps(True)
+    steps_off = exec_steps(False)
+
+    lc = lane_on["counters"]
+    hc = host_on["counters"]
+    result = {
+        "lane": {k: lc[k] for k in counters},
+        "host": {k: hc[k] for k in counters},
+        "steps_on": steps_on,
+        "steps_off": steps_off,
+        "lane_issues_identical":
+            lane_on["issues"] == lane_off["issues"],
+        "host_issues_identical":
+            host_on["issues"] == host_off["issues"],
+        "off_really_off": all(
+            lane_off["counters"][k] == 0
+            and host_off["counters"][k] == 0 for k in counters),
+        "unbounded_fires_host":
+            [s for s, _a, _t in host_unbounded["issues"]] == ["128"],
+        "unbounded_fires_lane":
+            [s for s, _a, _t in lane_unbounded["issues"]] == ["128"],
+        "bounded_silent": host_bounded_det["issues"] == [],
+        "issues": lane_on["issues"],
+    }
+    result["ok"] = bool(
+        lc["loop_summaries_verified"] > 0
+        and lc["loops_summarized_lanes"] > 0
+        and lc["unroll_iters_saved"] > 0
+        and hc["unroll_iters_saved"] > 0
+        and steps_on < steps_off
+        and result["lane_issues_identical"]
+        and result["host_issues_identical"]
+        and result["off_really_off"]
+        and result["unbounded_fires_host"]
+        and result["unbounded_fires_lane"]
+        and result["bounded_silent"]
+        and len(lane_on["issues"]) > 0
+        and lane_on["issues"] == host_on["issues"]
+    )
+    return result
+
+
 def _smoke_trace():
     """Stage 10: the observability gate (docs/observability.md).
 
@@ -2197,7 +2431,7 @@ def _smoke_ckpt():
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Twelve stages:
+    run-wide verdict cache — NO full corpus sweep. Thirteen stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -2281,6 +2515,16 @@ def bench_smoke():
        hidden behind following windows), a parked-state count
        strictly below the monolithic run, and issue identity vs
        MTPU_STREAM=0. Any miss exits 1.
+
+    13. the verified loop-summary gate (_smoke_loopsum,
+       docs/static_pass.md §loop summaries): a rigged counter-loop
+       dispatcher gating loop_summaries_verified > 0 (one recorded
+       solver proof per trusted summary), loops_summarized_lanes /
+       unroll_iters_saved > 0 on the lane path and
+       unroll_iters_saved > 0 on the host path, strictly fewer
+       executed instructions than MTPU_LOOPSUM=0, issue identity on
+       BOTH paths, and UnboundedLoopGas firing on the unbounded-taint
+       variant only. Any miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -2511,6 +2755,22 @@ def bench_smoke():
     else:
         out["stream"] = {"skipped": True, "ok": True}
 
+    # stage 13: the verified loop-summary gate (docs/static_pass.md
+    # §loop summaries): a rigged counter-loop dispatcher gating
+    # verified summaries (loop_summaries_verified > 0), skipped
+    # unrolling (unroll_iters_saved > 0, strictly fewer executed
+    # instructions than MTPU_LOOPSUM=0), issue identity on the host
+    # AND lane paths, and the UnboundedLoopGas detector firing on the
+    # unbounded-taint variant only; skippable via MTPU_SMOKE_LOOPSUM=0
+    if os.environ.get("MTPU_SMOKE_LOOPSUM", "1") != "0":
+        try:
+            out["loopsum"] = _smoke_loopsum()
+        except Exception as e:
+            out["loopsum"] = {"ok": False, "error": type(e).__name__,
+                              "detail": str(e)[:200]}
+    else:
+        out["loopsum"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -2558,7 +2818,12 @@ def bench_smoke():
           # overflow storm, spill twins merged before
           # materialization, deferred pulls provably hidden, and
           # issue identity vs the monolithic path
-          and out["stream"].get("ok", False))
+          and out["stream"].get("ok", False)
+          # the loop-summary gate: verified closed forms applied on
+          # both paths, unrolling provably skipped, issue identity vs
+          # MTPU_LOOPSUM=0, and UnboundedLoopGas firing on the
+          # unbounded-taint variant only
+          and out["loopsum"].get("ok", False))
     return 0 if ok else 1
 
 

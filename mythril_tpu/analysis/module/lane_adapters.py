@@ -274,6 +274,27 @@ class StateChangeAdapter(LaneAdapter):
         return not list(gs.get_annotations(StateChangeCallsAnnotation))
 
 
+class UnboundedLoopGasAdapter(LaneAdapter):
+    """The unbounded-loop detector's trigger is almost entirely STATIC
+    (a loop template with an unbounded, attacker-tainted hull —
+    modules/unbounded_loop_gas.loop_head_hit); only the final
+    satisfiability witness needs the site state. Device-executed
+    JUMPIs that fork carry their condition in the path-condition log,
+    so the module runs against the reconstructed site exactly like
+    the other taint-style JUMPI modules; concrete-condition JUMPIs
+    never fire it (a concrete condition means the instance is
+    bounded), and those produce no fork record anyway."""
+
+    lifted_hooks = frozenset({"JUMPI"})
+
+    def on_jumpi_site(self, cond, site):
+        from .modules.unbounded_loop_gas import loop_head_hit
+
+        code_obj = site.ctx.template.environment.code
+        if loop_head_hit(code_obj, site.byte_pc) is not None:
+            site.fire_module_pre_hook(self.module)
+
+
 class UserAssertionsAdapter(LaneAdapter):
     """The MSTORE hook only fires on concrete values matching the
     0xcafe… scribble pattern — the device parks exactly those
@@ -292,6 +313,7 @@ _ADAPTER_CLASSES = {
     "IntegerArithmetics": IntegerAdapter,
     "ArbitraryStorage": ArbitraryStorageAdapter,
     "StateChangeAfterCall": StateChangeAdapter,
+    "UnboundedLoopGas": UnboundedLoopGasAdapter,
     "UserAssertions": UserAssertionsAdapter,
 }
 

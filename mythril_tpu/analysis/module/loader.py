@@ -19,6 +19,7 @@ from .modules.integer import IntegerArithmetics
 from .modules.multiple_sends import MultipleSends
 from .modules.state_change_external_calls import StateChangeAfterCall
 from .modules.suicide import AccidentallyKillable
+from .modules.unbounded_loop_gas import UnboundedLoopGas
 from .modules.unchecked_retval import UncheckedRetval
 from .modules.user_assertions import UserAssertions
 
@@ -87,6 +88,7 @@ class ModuleLoader(object, metaclass=Singleton):
                 MultipleSends(),
                 StateChangeAfterCall(),
                 AccidentallyKillable(),
+                UnboundedLoopGas(),
                 UncheckedRetval(),
                 UserAssertions(),
             ]

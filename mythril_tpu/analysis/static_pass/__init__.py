@@ -148,6 +148,11 @@ class StaticInfo(NamedTuple):
     all_write_slots: Optional[FrozenSet[int]] = None
     #: every SSTORE slot AND value proved concrete (fact-seeding gate)
     writes_complete: bool = False
+    # -- verified closed-form loop summaries (PR 12; loop_summary.py,
+    # -- MTPU_LOOPSUM — plain picklable templates, verification state
+    # -- stays process-local beside the solver) ----------------------
+    #: recognized counter-loop templates (loop_summary.LoopTemplate)
+    loop_templates: Tuple[object, ...] = ()
 
     def mask_at(self, byte_pc: int, plane=None) -> int:
         table = self.reach_mask if plane is None else plane
@@ -188,6 +193,19 @@ def analyze(code: bytes) -> StaticInfo:
     except Exception as e:
         log.debug("selector/deps recovery failed (%s)", e)
         selector_map, func_deps = {}, {}
+    # counter-loop templates (loop_summary.py) — recognition is pure
+    # static data like the taint products; verification (the one
+    # solver query per loop) stays lazy at the consumer seams so the
+    # MTPU_LOOPSUM=0 path never touches the solver
+    loop_heads = loops_mod.loop_heads(cfg)
+    try:
+        from . import loop_summary as loopsum_mod
+
+        loop_templates = loopsum_mod.recognize(cfg, per_block,
+                                               loop_heads)
+    except Exception as e:
+        log.debug("loop-summary recognition failed (%s)", e)
+        loop_templates = ()
     info = StaticInfo(
         code_hash=memo.code_hash(code),
         length=len(code),
@@ -198,7 +216,7 @@ def analyze(code: bytes) -> StaticInfo:
         jumps_total=len(cfg.jump_table),
         reach_mask=mask,
         cycle_pcs=loops_mod.cycle_pcs(cfg),
-        loop_heads=loops_mod.loop_heads(cfg),
+        loop_heads=loop_heads,
         complete=cfg.complete,
         block_summaries=per_block,
         reach_reads=agg.reach_reads,
@@ -212,6 +230,7 @@ def analyze(code: bytes) -> StaticInfo:
         func_deps=func_deps,
         all_write_slots=agg.all_write_slots,
         writes_complete=agg.writes_complete,
+        loop_templates=loop_templates,
     )
     return info
 

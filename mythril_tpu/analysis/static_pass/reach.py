@@ -61,6 +61,7 @@ MODULE_ANCHORS: Dict[str, tuple] = {
     "MultipleSends": ("CALL", "DELEGATECALL", "STATICCALL",
                       "CALLCODE", "RETURN", "STOP"),
     "AccidentallyKillable": ("SELFDESTRUCT",),
+    "UnboundedLoopGas": ("JUMPI",),
     "UncheckedRetval": ("STOP", "RETURN"),
     "UserAssertions": ("LOG1", "MSTORE"),
 }

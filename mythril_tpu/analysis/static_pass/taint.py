@@ -317,6 +317,12 @@ SITE_RULES = {
         lambda st: _has(st.cond, ORIGIN | SLOAD),
     ("PredictableVariables", "JUMPI"):
         lambda st: _has(st.cond, TIMESTAMP | NUMBER | SLOAD),
+    # UnboundedLoopGas fires only on conditions PROVABLY carrying
+    # attacker-drivable flow (unbounded_loop_gas._attacker_tainted:
+    # CALLDATA/CALLVALUE/SLOAD, TOP excluded) — the refinement rule
+    # here must over-approximate that predicate, so TOP keeps the site
+    ("UnboundedLoopGas", "JUMPI"):
+        lambda st: _has(st.cond, CALLDATA | CALLVALUE | SLOAD),
 }
 
 

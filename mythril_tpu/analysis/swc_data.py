@@ -22,6 +22,7 @@ IMPROPER_VERIFICATION_BASED_ON_SIG = "122"
 WEAK_RANDOMNESS = "120"
 WRITE_TO_ARBITRARY_STORAGE = "124"
 ARBITRARY_JUMP = "127"
+DOS_WITH_BLOCK_GAS_LIMIT = "128"
 
 SWC_TO_TITLE = {
     "100": "Function Default Visibility",

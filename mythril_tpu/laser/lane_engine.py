@@ -1294,20 +1294,34 @@ _STATE_POOL: Dict[tuple, List[SymLaneState]] = {}
 
 def _compiled_code(code_bytes: bytes, fentries) -> "CompiledCode":
     from ..analysis import static_pass
+    from ..analysis.static_pass import loop_summary
 
     static_on = static_pass.enabled()
-    key = (code_bytes, tuple(sorted(fentries)), static_on)
+    info = static_pass.info_for(code_bytes) if static_on else None
+    det_mask = info.reach_mask if info is not None else None
+    # verified loop-summary park plane (docs/static_pass.md,
+    # MTPU_LOOPSUM): lanes arriving at a summarizable head park so the
+    # host applies the closed form instead of the device unrolling the
+    # loop. The cache key carries the marked head set — bench/tests
+    # flip the gate mid-process and must not adopt a stale plane.
+    loopsum_heads = ()
+    try:
+        if info is not None and loop_summary.enabled():
+            loopsum_heads = tuple(
+                sorted(loop_summary.summarizable_heads(info)))
+    except Exception as e:
+        log.debug("loop-summary heads unavailable: %s", e)
+    key = (code_bytes, tuple(sorted(fentries)), static_on,
+           loopsum_heads)
     cc = _CC_CACHE.get(key)
     if cc is None:
-        det_mask = None
-        if static_on:
-            info = static_pass.info_for(code_bytes)
-            if info is not None:
-                det_mask = info.reach_mask
+        loopsum_plane = (loop_summary.device_park_pcs(info)
+                         if loopsum_heads else None)
         with _prof("compile_code"), trace.span(
                 "xla.compile_code", code_len=len(code_bytes)):
             cc = compile_code(code_bytes, func_entries=key[1],
-                              det_mask=det_mask)
+                              det_mask=det_mask,
+                              loopsum_pcs=loopsum_plane)
         if len(_CC_CACHE) >= 64:  # bound device-resident code tensors
             _CC_CACHE.pop(next(iter(_CC_CACHE)))
         _CC_CACHE[key] = cc

@@ -92,6 +92,21 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
             if cur_instr["opcode"].upper() != "JUMPDEST":
                 return state
 
+            # verified loop-summary application (docs/static_pass.md,
+            # MTPU_LOOPSUM): a state at a recognized counter-loop head
+            # whose closed form is solver-verified jumps straight to
+            # the loop exit with the summarized counter/gas/depth
+            # effects instead of unrolling; an instance the bound
+            # would have pruned retires without burning bound+1
+            # iterations first. Declined/unverified instances fall
+            # through to the cycle scan below bit-for-bit.
+            action = self._loopsum_apply(state)
+            if action == "applied":
+                return state
+            if action == "retire":
+                log.debug("loop summary: bound-exceeded head retired")
+                continue
+
             # static loop-head feed (analysis/static_pass, MTPU_STATIC):
             # a JUMPDEST outside every non-trivial SCC of this code's
             # conservative CFG cannot sit on a repeating cycle of this
@@ -116,6 +131,20 @@ class BoundedLoopsStrategy(BasicSearchStrategy):
                 log.debug("Loop bound reached, skipping state")
                 continue
             return state
+
+    def _loopsum_apply(self, state: GlobalState):
+        """Summary application through the static pass's seam; any
+        failure degrades to unrolling (None)."""
+        try:
+            from ....analysis.static_pass import loop_summary
+
+            if not loop_summary.enabled():
+                return None
+            return loop_summary.maybe_apply(state,
+                                            loop_bound=self.bound)
+        except Exception as e:
+            log.debug("loop-summary application failed: %s", e)
+            return None
 
     @staticmethod
     def _static_cycle_pcs(state: GlobalState):

@@ -45,6 +45,18 @@ from .transaction import (
 log = logging.getLogger(__name__)
 
 
+def _loopsum_declined(gs) -> bool:
+    """Does this state carry a loop-summary decline marker
+    (analysis/static_pass/loop_summary.LoopsumDecline)?  Lazy import:
+    the sweep must stay importable with the static pass stripped."""
+    try:
+        from ..analysis.static_pass import loop_summary
+
+        return loop_summary.state_declined(gs)
+    except Exception:
+        return False
+
+
 class LaserEVM:
     """The symbolic EVM engine: explores the state space of a contract
     over a sequence of symbolic transactions."""
@@ -631,6 +643,13 @@ class LaserEVM:
                     return False
                 code_of[id(gs)] = code
                 return True
+            # a loop-summary DECLINE pins the family host-side: its
+            # loop would otherwise pay a park/materialize round trip
+            # per iteration at the device's summarizable-head plane
+            # (docs/static_pass.md, MTPU_LOOPSUM)
+            if _loopsum_declined(gs):
+                gs._lane_verdict = False
+                return False
             code = code_to_bytes(gs.environment.code)
             if code and lane_seedable(gs, exec_table=table):
                 code_of[id(gs)] = code
@@ -857,6 +876,23 @@ class LaserEVM:
                         module_names=static_module_names)
                 except Exception as e:
                     log.debug("static state screen failed: %s", e)
+            # verified loop-summary application (docs/static_pass.md,
+            # MTPU_LOOPSUM): lanes park at summarizable heads — apply
+            # the closed form here so applied states re-enter the
+            # worklist already AT the loop exit (and bound-exceeded
+            # instances retire without re-executing), instead of
+            # round-tripping through the strategy at the head
+            try:
+                from ..analysis.static_pass import loop_summary
+
+                if loop_summary.enabled():
+                    parked = loop_summary.apply_to_states(
+                        parked,
+                        loop_bound=getattr(self.strategy, "bound",
+                                           None))
+            except Exception as e:
+                log.debug("loop-summary sweep application failed: %s",
+                          e)
             run = engine.last_run_stats
             if slim_stop:
                 # transaction-end shortcut: lane-retired states parked

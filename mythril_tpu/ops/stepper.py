@@ -191,13 +191,13 @@ class CompiledCode(NamedTuple):
     contract — the analog of the reference's Disassembly object for the
     device path).
 
-    Stored as ONE packed (L+1, 13) i32 device array: separate per-field
+    Stored as ONE packed (L+1, 14) i32 device array: separate per-field
     H2D transfers each pay full link latency on a tunneled backend, and
     a jitted unpack dispatch pays an XLA compile per code bucket. The
     field views below slice the packed array — inside a trace XLA fuses
     them away; outside they are cheap lazy device ops."""
 
-    packed: jnp.ndarray  # (L+1, 13) int32, see column layout below
+    packed: jnp.ndarray  # (L+1, 14) int32, see column layout below
     size: int  # real code length (static)
 
     @property
@@ -232,6 +232,14 @@ class CompiledCode(NamedTuple):
 
         return lax.bitcast_convert_type(self.packed[:, 12], jnp.uint32)
 
+    @property
+    def loopsum_park(self):  # (L+1,) bool — verified loop-summary head
+        # (analysis/static_pass/loop_summary.py, MTPU_LOOPSUM): a lane
+        # arriving at a marked JUMPDEST parks NEEDS_HOST so the host
+        # applies the closed-form summary instead of the device
+        # unrolling the loop; all-zero when the layer is off
+        return self.packed[:, 13].astype(bool)
+
 
 # padded code-tensor sizes: every distinct tensor length is a separate
 # XLA compilation of the (large) stepper kernels, so contracts share a
@@ -253,13 +261,16 @@ def _code_bucket(length: int) -> int:
 
 
 def compile_code(code: bytes, func_entries=(),
-                 det_mask=None) -> CompiledCode:
+                 det_mask=None, loopsum_pcs=None) -> CompiledCode:
     """func_entries: byte addresses of function entry points (the
     Disassembly's address_to_function_name keys); lanes jumping there
     record it so materialized states carry the active function name.
     det_mask: optional (len(code)+1,) uint32 per-PC reachable-detector
     mask from the static pass (analysis/static_pass) — ships as one
-    more PC-indexed plane; zeros (= "no static info") when absent."""
+    more PC-indexed plane; zeros (= "no static info") when absent.
+    loopsum_pcs: optional (len(code)+1,) bool plane marking verified
+    loop-summary heads (loop_summary.device_park_pcs) — lanes park
+    there instead of unrolling; zeros when the layer is off."""
     length = len(code)
     padded = _code_bucket(length)
     opcode = np.full(padded + 1, _OP["STOP"], dtype=np.int32)
@@ -288,12 +299,17 @@ def compile_code(code: bytes, func_entries=(),
     if det_mask is not None:
         n = min(len(det_mask), padded + 1)
         mask_col[:n] = np.asarray(det_mask[:n], dtype=np.uint32)
+    loopsum_col = np.zeros(padded + 1, dtype=np.int32)
+    if loopsum_pcs is not None:
+        n = min(len(loopsum_pcs), padded + 1)
+        loopsum_col[:n] = np.asarray(loopsum_pcs[:n], dtype=bool)
     packed = np.concatenate([
         opcode[:, None], next_pc[:, None],
         is_jumpdest[:, None].astype(np.int32),
         is_func_entry[:, None].astype(np.int32),
         push_value.view(np.int32),
         mask_col[:, None].view(np.int32),
+        loopsum_col[:, None],
     ], axis=1)
     return CompiledCode(packed=jnp.asarray(packed), size=length)
 

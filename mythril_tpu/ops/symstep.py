@@ -820,6 +820,12 @@ def sym_step(code: CompiledCode, st: SymLaneState,
         # JUMPDEST and silently take an unconstrained jump)
         | (is_jumpi & ~sym_b & jumpi_taken_conc & (sym_a | ~dest_ok))
         | (is_jumpi & sym_b & (sym_a | ~dest_ok))
+        # verified loop-summary heads (loop_summary.device_park_pcs,
+        # MTPU_LOOPSUM): park BEFORE executing the head JUMPDEST so
+        # the host applies the closed-form summary instead of the
+        # device unrolling the loop; all-zero plane when the layer is
+        # off, so this term vanishes bit-for-bit
+        | code.loopsum_park[pc_c]
     )
 
     # ---- fork request / slot allocation (after park0 so capacity gaps

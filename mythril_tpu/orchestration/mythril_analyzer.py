@@ -22,13 +22,19 @@ log = logging.getLogger(__name__)
 
 
 def reset_analysis_state() -> None:
-    """Reset per-analysis global state (solver session, keccak axioms)
-    between independent contract analyses."""
+    """Reset per-analysis global state (solver session, keccak axioms,
+    execution deadline) between independent contract analyses. The
+    deadline clear matters beyond hygiene: the previous analysis's
+    window otherwise leaks into any solver call made before the next
+    engine run re-arms it — once the wall passes the stale deadline,
+    get_model raises UnsatError unconditionally."""
     from ..laser.function_managers import keccak_function_manager
+    from ..laser.time_handler import time_handler
     from ..smt.solver.core import reset_session
 
     reset_session()
     keccak_function_manager.reset()
+    time_handler.clear()
 
 
 def _resume_checkpoint_path(resume_dir: str) -> str:

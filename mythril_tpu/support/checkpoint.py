@@ -258,23 +258,36 @@ def load_verdict_sidecar(path) -> list:
         return []
 
 
+#: static-sidecar shape version: the payload frames a {"shape", "entries"}
+#: dict so a mixed-build fleet mid-deploy re-derives from bytes instead
+#: of pinning stale StaticInfo shapes into the memo. Bump whenever
+#: StaticInfo grows consumer-visible fields.
+#:   2 — StaticInfo carries loop_templates (PR 12, loop_summary.py);
+#:       pre-summary entries (and the PR-8-era bare-list framing)
+#:       are dropped on import.
+STATIC_SIDECAR_SHAPE = 2
+
+
 def save_static_sidecar(path, entries) -> bool:
     """Write a migration batch's static-pass sidecar: memoized
     analysis/static_pass.StaticInfo entries (plain picklable data — no
     terms, so no flat-table framing needed). The taint/dependence
     layer's products (PR 8: cfg, site taints, selector map, function
-    deps, write-completeness) are ordinary StaticInfo fields and ship
-    with the same pickle — a thief computes refined planes and the
-    tx-prune relation from them without re-running any fixpoint.
-    Best-effort, like the verdict sidecar: a failure must never block
-    the batch."""
+    deps, write-completeness) and the loop-summary templates (PR 12)
+    are ordinary StaticInfo fields and ship with the same pickle — a
+    thief computes refined planes, the tx-prune relation and verified
+    summaries from them without re-running any fixpoint. The payload
+    carries STATIC_SIDECAR_SHAPE so shape-skewed builds drop rather
+    than adopt. Best-effort, like the verdict sidecar: a failure must
+    never block the batch."""
     try:
         path = str(path)
         fd, tmp = tempfile.mkstemp(
             dir=os.path.dirname(os.path.abspath(path)) or ".",
             prefix=".ssc-")
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(list(entries), f,
+            pickle.dump({"shape": STATIC_SIDECAR_SHAPE,
+                         "entries": list(entries)}, f,
                         protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
         return True
@@ -286,22 +299,31 @@ def save_static_sidecar(path, entries) -> bool:
 
 def load_static_sidecar(path) -> list:
     """Inverse of save_static_sidecar; absent/corrupt loads as empty
-    (the thief re-analyzes — milliseconds, never wrong). Entries from
-    a build predating the taint layer (no ``taint_converged`` field)
-    are dropped rather than adopted: their namedtuple shape resolves
-    the new consumers' getattr probes to class defaults, which is
-    sound, but a mixed-build fleet mid-deploy should re-derive from
-    bytes instead of pinning stale shapes into the memo."""
+    (the thief re-analyzes — milliseconds, never wrong). A payload
+    whose shape version differs — including the PR-8-era bare-list
+    framing, which predates the loop-summary templates — is dropped
+    whole rather than adopted: a stale-shape StaticInfo resolves the
+    new consumers' getattr probes to class defaults, which is sound
+    but silently turns the new layers off for every shipped code."""
     try:
         if not os.path.exists(str(path)):
             return []
         with open(str(path), "rb") as f:
-            entries = list(pickle.load(f))
+            payload = pickle.load(f)
+        if not isinstance(payload, dict) \
+                or payload.get("shape") != STATIC_SIDECAR_SHAPE:
+            log.info("static sidecar: shape %s != %d — dropped "
+                     "(thief re-analyzes)",
+                     payload.get("shape") if isinstance(payload, dict)
+                     else "legacy-list", STATIC_SIDECAR_SHAPE)
+            return []
+        entries = list(payload.get("entries", ()))
         kept = [e for e in entries
                 if hasattr(e, "code_hash") and hasattr(e, "reach_mask")
-                and hasattr(e, "taint_converged")]
+                and hasattr(e, "taint_converged")
+                and hasattr(e, "loop_templates")]
         if len(kept) != len(entries):
-            log.info("static sidecar: dropped %d pre-taint-layer "
+            log.info("static sidecar: dropped %d stale-shape "
                      "entries (thief re-analyzes)",
                      len(entries) - len(kept))
         return kept

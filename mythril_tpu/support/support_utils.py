@@ -3,6 +3,7 @@ mythril/support/support_utils.py:14-101): Singleton metaclass, LRU cache,
 model quick-sat cache, and the keccak entry point (backed by the native
 library instead of the eth-hash wheel)."""
 
+import functools
 import logging
 from collections import OrderedDict
 from typing import Dict
@@ -179,17 +180,33 @@ def fold_concrete_bytes(seq) -> list:
 
 
 def get_code_hash(code) -> str:
-    """Keccak hash of hex bytecode string (reference support_utils.py:71-88)."""
+    """Keccak hash of hex bytecode string (reference support_utils.py:71-88).
+
+    The common str form is memoized: every DetectionModule.execute
+    call hashes the active code for its issue-cache key, so an
+    analysis pays one full keccak per hook firing — tens of thousands
+    of redundant hashes of the same handful of contracts per run."""
+    if isinstance(code, str):
+        return _code_hash_of_hex(code)
+    return _code_hash_of_obj(code)
+
+
+@functools.lru_cache(maxsize=1024)
+def _code_hash_of_hex(code: str) -> str:
     from ..native import keccak256
 
-    if isinstance(code, str):
-        code = code.replace("0x", "")
-        try:
-            hash_ = keccak256(bytes.fromhex(code))
-            return "0x" + hash_.hex()
-        except ValueError:
-            log.debug("invalid code hex: %s", code[:40])
-            return ""
+    code = code.replace("0x", "")
+    try:
+        hash_ = keccak256(bytes.fromhex(code))
+        return "0x" + hash_.hex()
+    except ValueError:
+        log.debug("invalid code hex: %s", code[:40])
+        return ""
+
+
+def _code_hash_of_obj(code) -> str:
+    from ..native import keccak256
+
     code = fold_concrete_bytes(code)
     if not all(isinstance(b, int) for b in code):
         # partially-symbolic runtime code: identity-hash the structure

@@ -85,6 +85,14 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("facts_seeded", "static_facts_seeded"),
         ("memo_evictions", "static_memo_evictions"),
     )),
+    ("Loop summaries", "docs/static_pass.md",
+     ("loop_summaries_verified", "loop_summaries_rejected",
+      "loops_summarized_lanes", "unroll_iters_saved"), (
+        ("verified", "loop_summaries_verified"),
+        ("rejected", "loop_summaries_rejected"),
+        ("lanes", "loops_summarized_lanes"),
+        ("iters_saved", "unroll_iters_saved"),
+    )),
     ("Verdict shipping", "docs/work_stealing.md",
      ("verdicts_shipped", "verdicts_replayed"), (
         ("shipped", "verdicts_shipped"),

@@ -21,3 +21,26 @@ force_virtual_cpu(8)
 from mythril_tpu.support.devices import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_execution_deadline():
+    """Clear the global execution deadline around every test.
+
+    `time_handler` is a process-wide singleton and `get_model` turns a
+    passed deadline into an unconditional UnsatError — so any test
+    that runs an analysis with a finite `execution_timeout` plants a
+    time bomb for every later test that touches the solver without
+    starting its own window. Which victim explodes depends on suite
+    pacing (it surfaced as order-dependent lane_merge/propagate/repair
+    failures only under full-suite wall times). Every engine entry
+    point re-arms the deadline via start_execution, so clearing it
+    here never changes a test's own semantics.
+    """
+    from mythril_tpu.laser.time_handler import time_handler
+
+    time_handler.clear()
+    yield
+    time_handler.clear()

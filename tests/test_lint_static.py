@@ -281,3 +281,46 @@ def test_retire_gather_outside_laser_ok(tmp_path):
             return _retire_rows(st, None, 8, 64, 8, 8)
     """)
     assert findings == []
+
+
+def test_z3_import_in_static_pass_flagged(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/analysis/static_pass/bad_z3.py", """\
+        import z3
+
+        def prove(q):
+            return z3.Solver().check(q)
+    """)
+    assert [f.rule for f in findings] == ["solver-import-in-static-pass"]
+    assert findings[0].line == 1
+
+
+def test_solver_core_import_in_static_pass_flagged(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/analysis/static_pass/bad_core.py", """\
+        from ...smt.solver import core
+        from ...smt.solver.pool import get_pool
+        from ...native import SatSolver
+    """)
+    assert [f.rule for f in findings] == [
+        "solver-import-in-static-pass"] * 3
+
+
+def test_batch_discharge_import_in_static_pass_ok(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/analysis/static_pass/good_batch.py", """\
+        def verify(query):
+            from ...smt.solver import batch
+            from ...smt.solver.solver_statistics import SolverStatistics
+
+            return batch.discharge([query])[0] == batch.UNSAT
+    """)
+    assert findings == []
+
+
+def test_solver_import_outside_static_pass_ok(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/analysis/elsewhere.py", """\
+        from ..smt.solver import core
+    """)
+    assert findings == []

@@ -1,0 +1,498 @@
+"""Verified closed-form loop summaries (docs/static_pass.md §loop
+summaries, MTPU_LOOPSUM — analysis/static_pass/loop_summary.py).
+
+Covers the PR's soundness surface:
+
+* randomized soundness property: generated counter loops are executed
+  CONCRETELY through the real engine and the summary's predicted
+  (iteration count, exit value) — and the applied run's final storage,
+  gas interval and state count — must match the unrolled run exactly;
+* rejection degrades to unrolling bit-for-bit (a summary whose
+  verification is forced to fail changes nothing);
+* off-switch parity (MTPU_LOOPSUM=0 == pre-PR behavior, counters 0);
+* UnboundedLoopGas detector: fires on an unbounded attacker-tainted
+  hull, stays silent on a constant-bounded loop and under the gate;
+* static-sidecar shape roundtrip: v2 payloads carry loop templates,
+  legacy payloads drop whole.
+"""
+
+import random
+
+import pytest
+
+from mythril_tpu.analysis import static_pass
+from mythril_tpu.analysis.static_pass import loop_summary
+from mythril_tpu.analysis.static_pass import memo as static_memo
+from mythril_tpu.support.opcodes import ADDRESS, OPCODES
+
+from .harness import ADDR, CALLER
+
+_OP = {name: data[ADDRESS] for name, data in OPCODES.items()}
+
+WORD = 1 << 256
+
+
+def _push(v, n=1):
+    return bytes([0x5F + n]) + int(v).to_bytes(n, "big")
+
+
+def build_counter_loop(init, bound, stride, style="iszero_fall",
+                       bound_on_stack=False, store_slot=1):
+    """``for (i = init; i < bound; i += stride) {}`` with the exit
+    value committed to storage (observable, and the SSTORE keeps the
+    loop region analysis-alive for the static retire screen).
+
+    styles:
+    * ``iszero_fall`` — head tests ``GT`` then ``ISZERO`` and JUMPIs
+      to the exit (body = fallthrough; solc's while-shape);
+    * ``jump_body``  — head JUMPIs to the body on the raw condition
+      (exit = fallthrough).
+    """
+    c = bytearray()
+    if bound_on_stack:
+        c += _push(bound, 32)
+    c += _push(init, 32)
+    head = len(c)
+    c += bytes([_OP["JUMPDEST"]])
+    if bound_on_stack:
+        # [b, i] -> DUP2 DUP2 -> [b, i, b, i]; LT: i < b
+        c += bytes([_OP["DUP2"], _OP["DUP2"], _OP["LT"]])
+    else:
+        # [i] -> DUP1 PUSH b -> [i, i, b]; GT: b > i == i < b
+        c += bytes([_OP["DUP1"]]) + _push(bound, 32) + \
+            bytes([_OP["GT"]])
+    body_tail = _push(stride, 32) + bytes([_OP["ADD"]]) + \
+        _push(head, 2) + bytes([_OP["JUMP"]])
+    if style == "iszero_fall":
+        c += bytes([_OP["ISZERO"]])
+        jp = len(c)
+        c += _push(0, 2) + bytes([_OP["JUMPI"]])
+        c += body_tail
+        exit_pc = len(c)
+        c[jp + 1:jp + 3] = exit_pc.to_bytes(2, "big")
+        c += bytes([_OP["JUMPDEST"]])
+    else:  # jump_body
+        jp = len(c)
+        c += _push(0, 2) + bytes([_OP["JUMPI"]])
+        # fallthrough = exit
+        c += _push(store_slot) + bytes([_OP["SSTORE"]])
+        if bound_on_stack:
+            c += bytes([_OP["POP"]])
+        c += bytes([_OP["STOP"]])
+        body_pc = len(c)
+        c[jp + 1:jp + 3] = body_pc.to_bytes(2, "big")
+        c += bytes([_OP["JUMPDEST"]]) + body_tail
+        return bytes(c), head
+    c += _push(store_slot) + bytes([_OP["SSTORE"]])
+    if bound_on_stack:
+        c += bytes([_OP["POP"]])
+    c += bytes([_OP["STOP"]])
+    return bytes(c), head
+
+
+def _oracle(init, bound, stride, bound_kind="ULT", cap=1 << 20):
+    """Concrete EVM-semantics loop twin: (iterations, exit value), or
+    None past the cap (the engine-level tests never go there)."""
+    i, n = init % WORD, 0
+    while (i < bound if bound_kind == "ULT" else i <= bound):
+        i = (i + stride) % WORD
+        n += 1
+        if n > cap:
+            return None
+    return n, i
+
+
+def _run(code, loopsum, loop_bound=64, calldata=b""):
+    """One concrete message call through the REAL svm with the
+    bounded-loops strategy wrapped (harness.run_concrete does not wrap
+    it, and the strategy is the host application seam)."""
+    from mythril_tpu.disassembler.disassembly import Disassembly
+    from mythril_tpu.laser.strategy.extensions.bounded_loops import (
+        BoundedLoopsStrategy,
+    )
+    from mythril_tpu.laser.svm import LaserEVM
+    from mythril_tpu.laser.state.world_state import WorldState
+    from mythril_tpu.laser.transaction.concolic import (
+        execute_message_call,
+    )
+    from mythril_tpu.smt import symbol_factory
+
+    loop_summary.FORCE = loopsum
+    static_memo.clear()
+    loop_summary.reset_for_tests()
+    try:
+        laser = LaserEVM(requires_statespace=False,
+                         execution_timeout=120)
+        laser.extend_strategy(BoundedLoopsStrategy,
+                              loop_bound=loop_bound)
+        world_state = WorldState()
+        account = world_state.create_account(
+            address=ADDR, concrete_storage=True)
+        account.set_balance(10 ** 18)
+        account.code = Disassembly(code.hex())
+        laser.open_states = [world_state]
+        final_states = execute_message_call(
+            laser,
+            callee_address=symbol_factory.BitVecVal(ADDR, 256),
+            caller_address=symbol_factory.BitVecVal(CALLER, 256),
+            origin_address=symbol_factory.BitVecVal(CALLER, 256),
+            code=code.hex(),
+            data=list(calldata),
+            gas_limit=8000000,
+            gas_price=10,
+            value=0,
+            track_gas=True,
+        )
+        return final_states, laser
+    finally:
+        loop_summary.FORCE = None
+        static_memo.clear()
+        # drop this run's execution deadline: leaving it armed turns
+        # later tests' get_model calls into stale-deadline UnsatErrors
+        from mythril_tpu.laser.time_handler import time_handler
+
+        time_handler.clear()
+
+
+def _storage(laser, slot):
+    from mythril_tpu.smt import symbol_factory
+
+    account = laser.open_states[0].accounts[ADDR]
+    val = account.storage[symbol_factory.BitVecVal(slot, 256)]
+    if isinstance(val, int):
+        return val
+    assert val.value is not None
+    return val.value
+
+
+def _counters():
+    from mythril_tpu.smt.solver.solver_statistics import (
+        SolverStatistics,
+    )
+
+    c = SolverStatistics().batch_counters()
+    return {k: c[k] for k in ("loop_summaries_verified",
+                              "loop_summaries_rejected",
+                              "loops_summarized_lanes",
+                              "unroll_iters_saved")}
+
+
+# -- recognition + closed form ----------------------------------------------
+
+
+class TestRecognition:
+    def test_canonical_shapes(self):
+        for style in ("iszero_fall", "jump_body"):
+            for bound_on_stack in (False, True):
+                code, head = build_counter_loop(
+                    0, 9, 1, style=style,
+                    bound_on_stack=bound_on_stack)
+                info = static_pass.analyze(code)
+                t = loop_summary.template_at_head(info, head)
+                assert t is not None, (style, bound_on_stack)
+                assert t.pure and t.stride == 1 and t.cmp == "ULT"
+                if bound_on_stack:
+                    assert t.bound_const is None
+                    assert t.bound_depth is not None
+                    assert t.unbounded
+                else:
+                    assert t.bound_const == 9
+                    assert not t.unbounded
+
+    def test_impure_body_not_pure(self):
+        # an SSTORE inside the body: counter recurrence may still
+        # recognize but the template must never be applied
+        c = bytearray()
+        c += _push(0, 32)
+        head = len(c)
+        c += bytes([_OP["JUMPDEST"], _OP["DUP1"]]) + _push(9, 32) + \
+            bytes([_OP["GT"], _OP["ISZERO"]])
+        jp = len(c)
+        c += _push(0, 2) + bytes([_OP["JUMPI"]])
+        c += bytes([_OP["DUP1"], _OP["DUP1"]]) + \
+            bytes([_OP["SSTORE"]])  # storage write per iteration
+        c += _push(1, 32) + bytes([_OP["ADD"]]) + _push(head, 2) + \
+            bytes([_OP["JUMP"]])
+        ex = len(c)
+        c[jp + 1:jp + 3] = ex.to_bytes(2, "big")
+        c += bytes([_OP["JUMPDEST"], _OP["POP"], _OP["STOP"]])
+        info = static_pass.analyze(bytes(c))
+        t = loop_summary.template_at_head(info, head)
+        if t is not None:
+            assert not t.pure
+
+    def test_predict_matches_oracle_randomized(self):
+        rng = random.Random(0x100F)
+        code, head = build_counter_loop(0, 9, 1)
+        info = static_pass.analyze(code)
+        t = loop_summary.template_at_head(info, head)
+        assert t is not None
+        for _ in range(200):
+            stride = rng.choice((1, 2, 3, 5, 7, 64, 1000))
+            t2 = t._replace(stride=stride)
+            kind = rng.choice(("ULT", "ULE"))
+            t2 = t2._replace(cmp=kind)
+            if rng.random() < 0.3:
+                c0 = rng.randrange(WORD - (1 << 20), WORD)
+                bound = rng.randrange(WORD - (1 << 20), WORD)
+            else:
+                c0 = rng.randrange(0, 1 << 20)
+                bound = rng.randrange(0, 1 << 20)
+            got = loop_summary.predict(t2, c0, bound)
+            want = _oracle(c0, bound, stride, kind)
+            if got is None:
+                # side conditions excluded the instance: legal only
+                # near the wrap boundary
+                assert bound > WORD - stride - 2
+                continue
+            assert want is not None, (c0, bound, stride, kind)
+            assert got == want, (c0, bound, stride, kind)
+
+
+class TestVerification:
+    def test_verified_and_recorded(self):
+        code, head = build_counter_loop(0, 9, 1)
+        static_memo.clear()
+        loop_summary.reset_for_tests()
+        info = static_pass.analyze(code)
+        t = loop_summary.template_at_head(info, head)
+        c0 = _counters()
+        assert loop_summary.verified_instance(info, t)
+        c1 = _counters()
+        assert c1["loop_summaries_verified"] == \
+            c0["loop_summaries_verified"] + 1
+        # memoized: the second call runs no new query
+        assert loop_summary.verified_instance(info, t)
+        assert _counters()["loop_summaries_verified"] == \
+            c1["loop_summaries_verified"]
+
+    def test_broken_closed_form_rejected(self, monkeypatch):
+        """The solver is the safety net: a wrong stride in the claim
+        must produce a counterexample, not a trusted summary."""
+        code, head = build_counter_loop(0, 9, 1)
+        static_memo.clear()
+        loop_summary.reset_for_tests()
+        info = static_pass.analyze(code)
+        t = loop_summary.template_at_head(info, head)
+
+        def broken_query(tt, code_hash, bound):
+            # the real builder with an off-by-one iteration count
+            # (ceil of (b - i) instead of (b - 1 - i)): the last
+            # claimed iteration lands ON the bound, which the solver
+            # must refute with a counterexample
+            from mythril_tpu.smt import terms as T
+
+            i = T.bv_var("lsumbad_%d_i" % bound, 256)
+            b = T.bv_const(bound, 256)
+            s = T.bv_const(tt.stride, 256)
+            one = T.bv_const(1, 256)
+            entry = T.mk_ult(i, b)
+            n = T.mk_add(T.mk_udiv(T.mk_sub(b, i), s), one)
+            side = T.mk_ule(
+                b, T.bv_const((1 << 256) - tt.stride, 256))
+            last = T.mk_add(i, T.mk_mul(T.mk_sub(n, one), s))
+            exitv = T.mk_add(last, s)
+            claim = T.mk_bool_and(
+                T.mk_not(T.mk_ult(exitv, b)),
+                T.mk_ult(last, b),
+                T.mk_ule(i, last),
+                T.mk_ule(last, exitv),
+            )
+            return [side, entry, T.mk_not(claim)]
+
+        monkeypatch.setattr(loop_summary, "_verify_query",
+                            broken_query)
+        c0 = _counters()
+        assert not loop_summary.verified_instance(info, t)
+        assert _counters()["loop_summaries_rejected"] == \
+            c0["loop_summaries_rejected"] + 1
+
+
+# -- engine-level identity ---------------------------------------------------
+
+
+class TestApplicationParity:
+    @pytest.mark.parametrize("style", ("iszero_fall", "jump_body"))
+    def test_applied_equals_unrolled(self, style):
+        code, _head = build_counter_loop(3, 40, 7, style=style)
+        want = _oracle(3, 40, 7)
+        on_states, on_laser = _run(code, True)
+        on_counters = _counters()
+        off_states, off_laser = _run(code, False)
+        assert _storage(on_laser, 1) == _storage(off_laser, 1) \
+            == want[1]
+        assert len(on_states) == len(off_states) == 1
+        assert on_states[0].mstate.min_gas_used == \
+            off_states[0].mstate.min_gas_used
+        assert on_states[0].mstate.max_gas_used == \
+            off_states[0].mstate.max_gas_used
+        assert on_states[0].mstate.depth == off_states[0].mstate.depth
+        # the applied run never executed the iterations
+        assert on_laser.total_states < off_laser.total_states
+
+    def test_randomized_concrete_parity(self):
+        rng = random.Random(1234)
+        for _ in range(6):
+            init = rng.randrange(0, 50)
+            bound = rng.randrange(0, 60)
+            stride = rng.choice((1, 2, 3, 9))
+            bound_on_stack = rng.random() < 0.5
+            code, _head = build_counter_loop(
+                init, bound, stride, bound_on_stack=bound_on_stack)
+            want = _oracle(init, bound, stride)
+            on_states, on_laser = _run(code, True)
+            off_states, off_laser = _run(code, False)
+            assert _storage(on_laser, 1) == _storage(off_laser, 1) \
+                == want[1], (init, bound, stride, bound_on_stack)
+            assert len(on_states) == len(off_states)
+            assert on_states[0].mstate.min_gas_used == \
+                off_states[0].mstate.min_gas_used
+
+    def test_bound_exceeded_retires_like_prune(self):
+        # n=100 > loop_bound=8: BOTH runs end with the loop path
+        # dropped and no storage write; the summarized run must not
+        # have executed the 9 wasted iterations
+        code, _head = build_counter_loop(0, 100, 1)
+        on_states, on_laser = _run(code, True, loop_bound=8)
+        off_states, off_laser = _run(code, False, loop_bound=8)
+        assert len(on_states) == len(off_states) == 0
+        assert on_laser.total_states < off_laser.total_states
+
+    def test_rejection_degrades_to_unrolling(self, monkeypatch):
+        code, _head = build_counter_loop(3, 40, 7)
+        off_states, off_laser = _run(code, False)
+        off_storage = _storage(off_laser, 1)
+        off_total = off_laser.total_states
+        monkeypatch.setattr(loop_summary, "verified_instance",
+                            lambda *a, **k: False)
+        on_states, on_laser = _run(code, True)
+        assert _storage(on_laser, 1) == off_storage
+        assert len(on_states) == len(off_states)
+        assert on_laser.total_states == off_total
+        assert on_states[0].mstate.min_gas_used == \
+            off_states[0].mstate.min_gas_used
+
+    def test_off_switch_really_off(self):
+        code, _head = build_counter_loop(3, 40, 7)
+        c0 = _counters()
+        _run(code, False)
+        c1 = _counters()
+        assert c0 == c1  # no counter moved with the gate down
+
+
+# -- the UnboundedLoopGas detector ------------------------------------------
+
+
+def _analyze_issues(code, modules, loopsum=True, tx_count=1):
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.support.analysis_args import make_cmd_args
+
+    loop_summary.FORCE = loopsum
+    try:
+        reset_analysis_state()
+        static_memo.clear()
+        loop_summary.reset_for_tests()
+        dis = MythrilDisassembler(eth=None)
+        address, _ = dis.load_from_bytecode(code.hex(),
+                                            bin_runtime=True)
+        analyzer = MythrilAnalyzer(
+            disassembler=dis,
+            cmd_args=make_cmd_args(execution_timeout=120,
+                                   tpu_lanes=0, loop_bound=8),
+            strategy="bfs", address=address)
+        report = analyzer.fire_lasers(modules=list(modules),
+                                      transaction_count=tx_count)
+        return sorted((i.swc_id, i.address)
+                      for i in report.issues.values())
+    finally:
+        loop_summary.FORCE = None
+        static_memo.clear()
+        from mythril_tpu.laser.time_handler import time_handler
+
+        time_handler.clear()
+
+
+def build_calldata_bound_loop():
+    """Loop bounded by calldataload(4) — unbounded, attacker-tainted."""
+    c = bytearray()
+    c += _push(4) + bytes([_OP["CALLDATALOAD"]])
+    c += _push(0)
+    head = len(c)
+    c += bytes([_OP["JUMPDEST"], _OP["DUP2"], _OP["DUP2"],
+                _OP["LT"], _OP["ISZERO"]])
+    jp = len(c)
+    c += _push(0, 2) + bytes([_OP["JUMPI"]])
+    c += _push(1) + bytes([_OP["ADD"]]) + _push(head, 2) + \
+        bytes([_OP["JUMP"]])
+    ex = len(c)
+    c[jp + 1:jp + 3] = ex.to_bytes(2, "big")
+    c += bytes([_OP["JUMPDEST"], _OP["POP"], _OP["POP"],
+                _OP["STOP"]])
+    return bytes(c), head
+
+
+class TestUnboundedLoopGas:
+    def test_tainted_unbounded_fires(self):
+        code, _head = build_calldata_bound_loop()
+        issues = _analyze_issues(code, ["UnboundedLoopGas"])
+        assert [s for s, _a in issues] == ["128"]
+
+    def test_constant_bound_does_not_fire(self):
+        code, _head = build_counter_loop(0, 12, 1)
+        issues = _analyze_issues(code, ["UnboundedLoopGas"])
+        assert issues == []
+
+    def test_gate_down_does_not_fire(self):
+        code, _head = build_calldata_bound_loop()
+        issues = _analyze_issues(code, ["UnboundedLoopGas"],
+                                 loopsum=False)
+        assert issues == []
+
+
+# -- sidecar shape roundtrip -------------------------------------------------
+
+
+class TestSidecarShape:
+    def test_v2_roundtrip_keeps_templates(self, tmp_path):
+        from mythril_tpu.support.checkpoint import (
+            load_static_sidecar, save_static_sidecar,
+        )
+
+        code, head = build_counter_loop(0, 9, 1)
+        static_memo.clear()
+        info = static_pass.analyze(code)
+        static_memo.put(info.code_hash, info)
+        side = tmp_path / "static.sidecar"
+        assert save_static_sidecar(side, static_memo.export_entries())
+        got = load_static_sidecar(side)
+        assert len(got) == 1
+        t = loop_summary.template_at_head(got[0], head)
+        assert t is not None and t.pure and t.stride == 1
+
+    def test_legacy_payload_dropped_whole(self, tmp_path):
+        import pickle
+
+        from mythril_tpu.support.checkpoint import load_static_sidecar
+
+        code, _head = build_counter_loop(0, 9, 1)
+        static_memo.clear()
+        info = static_pass.analyze(code)
+        side = tmp_path / "legacy.sidecar"
+        with open(side, "wb") as f:
+            pickle.dump([info], f)  # PR-8-era bare-list framing
+        assert load_static_sidecar(side) == []
+
+    def test_wrong_shape_dropped_whole(self, tmp_path):
+        import pickle
+
+        from mythril_tpu.support.checkpoint import load_static_sidecar
+
+        side = tmp_path / "skew.sidecar"
+        with open(side, "wb") as f:
+            pickle.dump({"shape": 1, "entries": [object()]}, f)
+        assert load_static_sidecar(side) == []

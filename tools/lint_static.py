@@ -68,6 +68,20 @@ single-allocation shape that kernel-faulted 64k-wide LIVE windows
 not with the chunk bound. New call sites must go through
 ``_retire_chunked`` — or be explicitly allowlisted with a reason.
 
+Rule 7 — solver-import-in-static-pass (the PR-12 loop-summary
+class): importing a solver backend directly inside
+``mythril_tpu/analysis/static_pass/`` — the ``z3`` package (the
+reference's backend; not even installed here), the native SAT core
+(``mythril_tpu/native``/``SatSolver``), or the solver core/pool
+modules (``smt.solver.core`` / ``smt.solver.pool``). Static-pass
+clients that need proofs (loop-summary verification) must discharge
+through ``smt.solver.batch`` so the verdict cache, subset kills,
+query hints and worker pooling apply to their queries exactly like
+every other feasibility query — a direct core session would bypass
+all of it and silently fork the solver-state assumptions the batch
+layer maintains. ``batch`` / ``verdicts`` / ``solver_statistics``
+imports stay sanctioned.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -152,6 +166,58 @@ _PICKLE_CALLS = frozenset(("dump", "load", "dumps", "loads"))
 _RULE6_ROOT = "mythril_tpu/laser/"
 _RULE6_SANCTIONED = frozenset(
     ("_retire_chunked", "_warm_one_inner", "_probe_width"))
+
+#: rule-7 scope + the module suffixes a static-pass client must not
+#: import (the batch.discharge seam is the one sanctioned solver
+#: surface there — see the module docstring)
+_RULE7_ROOT = "mythril_tpu/analysis/static_pass/"
+_RULE7_BANNED_TAILS = (("smt", "solver", "core"),
+                       ("smt", "solver", "pool"),
+                       ("native",))
+_RULE7_BANNED_NAMES = frozenset(("core", "pool", "SatSolver"))
+
+
+def _mod_parts(module) -> tuple:
+    return tuple(p for p in (module or "").split(".") if p)
+
+
+def _rule7_findings(rel: str, tree) -> List["Finding"]:
+    out: List[Finding] = []
+
+    def flag(node, what):
+        out.append(Finding(
+            rel, node.lineno, "solver-import-in-static-pass",
+            "static-pass client imports {} directly — summaries must "
+            "verify through smt.solver.batch.discharge so verdict "
+            "caching/subset kills/pooling apply (or allowlist with a "
+            "reason)".format(what)))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                parts = _mod_parts(alias.name)
+                if "z3" in parts:
+                    flag(node, "z3")
+                elif any(parts[-len(t):] == t
+                         for t in _RULE7_BANNED_TAILS):
+                    flag(node, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            parts = _mod_parts(node.module)
+            if "z3" in parts:
+                flag(node, "z3")
+                continue
+            if any(parts[-len(t):] == t for t in _RULE7_BANNED_TAILS
+                   if len(parts) >= len(t)):
+                flag(node, node.module or ".")
+                continue
+            # `from ..smt.solver import core/pool`, `from ..native
+            # import SatSolver`-style member imports
+            if parts[-2:] == ("smt", "solver") or \
+                    (parts and parts[-1] == "native"):
+                for alias in node.names:
+                    if alias.name in _RULE7_BANNED_NAMES:
+                        flag(node, alias.name)
+    return out
 
 
 def _is_retire_gather_call(node: ast.Call) -> bool:
@@ -326,6 +392,9 @@ def lint_file(path: Path) -> List[Finding]:
 
     if rel.startswith(_RULE6_ROOT):
         out.extend(_retire_gather_findings(rel, tree))
+
+    if rel.startswith(_RULE7_ROOT):
+        out.extend(_rule7_findings(rel, tree))
 
     if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
         for node in ast.walk(tree):
