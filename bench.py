@@ -2428,10 +2428,111 @@ def _smoke_ckpt():
     }
 
 
+def _smoke_warm():
+    """Stage 14: the cross-run warm-store gate (docs/warm_store.md).
+
+    Cold-then-warm analysis of the SAME fixture in two separate
+    processes over one --out-dir:
+
+    * the warm run's issue report is IDENTICAL to the cold run's;
+    * the warm run adopts banks: ``verdicts_warmed > 0`` AND
+      ``static_warmed > 0`` (the static memo filled from the store,
+      not from a fresh pass);
+    * the warm run's solver-query count (every core.check, via the
+      per-tactic wall histograms) is STRICTLY below the cold run's —
+      the avoided-work wall win, legitimate even on a single-CPU box;
+    * ``MTPU_WARM=0`` is really off: two runs over a fresh out-dir
+      create NO store files, report identically to the cold default
+      run, and bank nothing (warm counters all zero)."""
+    import shutil
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from tests.fixture_paths import INPUTS
+
+    tmp = Path(tempfile.mkdtemp(prefix="mtpu_warm_smoke_"))
+    fixture = INPUTS / "origin.sol.o"
+
+    def _run(out_name, env_extra):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("MTPU_WARM_DIR", None)
+        env.update(env_extra)
+        out_dir = tmp / out_name
+        proc = subprocess.run(
+            [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+             "--out-dir", str(out_dir), "--timeout", "120",
+             str(fixture)],
+            cwd=str(Path(__file__).resolve().parent), env=env,
+            capture_output=True, text=True, timeout=420)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"warm-smoke run failed:\n{proc.stderr[-2000:]}")
+        return json.loads(
+            (out_dir / "corpus_report.json").read_text())
+
+    def _canon(report):
+        return [(c["contract"], c.get("issues"), c.get("swc"))
+                for c in report["contracts"]]
+
+    def _queries(report):
+        hists = report["shards"][0].get("metrics", {}).get(
+            "histograms", {})
+        return sum(h.get("count", 0) for name, h in hists.items()
+                   if name.startswith("solver_wall_ms."))
+
+    def _solver(report):
+        return report["shards"][0].get("solver", {})
+
+    t0 = time.perf_counter()
+    try:
+        cold = _run("store", {})
+        warm = _run("store", {})
+        off = _run("off", {"MTPU_WARM": "0"})
+        off2 = _run("off", {"MTPU_WARM": "0"})
+    except Exception as e:
+        shutil.rmtree(tmp, ignore_errors=True)
+        return {"error": type(e).__name__, "detail": str(e)[:500],
+                "ok": False}
+    off_store_files = (tmp / "off" / "warm").exists()
+    wall = round(time.perf_counter() - t0, 1)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    ws, os_ = _solver(warm), _solver(off2)
+    gates = {
+        "issue_identity": _canon(cold) == _canon(warm),
+        "warm_hit": ws.get("warm_hits", 0) > 0,
+        "verdicts_warmed": ws.get("verdicts_warmed", 0) > 0,
+        "static_warmed": ws.get("static_warmed", 0) > 0,
+        "warm_queries_below_cold": _queries(warm) < _queries(cold),
+        # MTPU_WARM=0 really-off: no store files, identical report,
+        # zero warm counters even on the second run over the dir
+        "off_no_store_files": not off_store_files,
+        "off_identity": _canon(off) == _canon(off2) == _canon(cold),
+        "off_banks_nothing": (os_.get("warm_hits", 0) == 0
+                              and os_.get("warm_misses", 0) == 0
+                              and os_.get("verdicts_warmed", 0) == 0),
+    }
+    return {
+        "wall_s": wall,
+        "cold_queries": _queries(cold),
+        "warm_queries": _queries(warm),
+        "verdicts_warmed": ws.get("verdicts_warmed", 0),
+        "facts_warmed": ws.get("facts_warmed", 0),
+        "static_warmed": ws.get("static_warmed", 0),
+        "route_first_try_wins": ws.get("route_first_try_wins", 0),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
-    run-wide verdict cache — NO full corpus sweep. Thirteen stages:
+    run-wide verdict cache — NO full corpus sweep. Fourteen stages:
 
     1. a tiny symbolic explore (2^4 paths, 64 lanes) through the lane
        engine with fork pruning engaged, so the window-pipeline overlap
@@ -2525,6 +2626,15 @@ def bench_smoke():
        executed instructions than MTPU_LOOPSUM=0, issue identity on
        BOTH paths, and UnboundedLoopGas firing on the unbounded-taint
        variant only. Any miss exits 1.
+
+    14. the cross-run warm-store gate (_smoke_warm,
+       docs/warm_store.md): cold-then-warm analysis of one fixture in
+       two processes over one --out-dir gating issue identity,
+       verdicts_warmed > 0 AND static_warmed > 0 on the warm run, a
+       warm solver-query count strictly below cold (avoided work, not
+       parallelism — legitimate on the single-CPU box), and
+       MTPU_WARM=0 really off (no store files touched, bit-for-bit
+       cold behavior). Any miss exits 1.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -2771,6 +2881,21 @@ def bench_smoke():
     else:
         out["loopsum"] = {"skipped": True, "ok": True}
 
+    # stage 14: the cross-run warm-store gate (docs/warm_store.md):
+    # cold-then-warm analysis of one fixture in two processes over one
+    # --out-dir — issue identity, verdicts_warmed/static_warmed > 0,
+    # warm solver-query count strictly below cold, and MTPU_WARM=0
+    # really off (no store files, identical cold report, zero warm
+    # counters); skippable via MTPU_SMOKE_WARM=0
+    if os.environ.get("MTPU_SMOKE_WARM", "1") != "0":
+        try:
+            out["warm"] = _smoke_warm()
+        except Exception as e:
+            out["warm"] = {"ok": False, "error": type(e).__name__,
+                           "detail": str(e)[:200]}
+    else:
+        out["warm"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -2823,7 +2948,12 @@ def bench_smoke():
           # both paths, unrolling provably skipped, issue identity vs
           # MTPU_LOOPSUM=0, and UnboundedLoopGas firing on the
           # unbounded-taint variant only
-          and out["loopsum"].get("ok", False))
+          and out["loopsum"].get("ok", False)
+          # the warm-store gate: a second-process analysis of the same
+          # code answers from prior proofs (banks adopted, strictly
+          # fewer solver queries, identical issues) and MTPU_WARM=0 is
+          # bit-for-bit cold with no store files touched
+          and out["warm"].get("ok", False))
     return 0 if ok else 1
 
 
@@ -2910,6 +3040,12 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--no-warm-store" in sys.argv[1:]:
+        # cross-run warm store stand-down for this bench process
+        # (support/warm_store.py; same as MTPU_WARM=0)
+        from mythril_tpu.support.support_args import args as _sargs
+
+        _sargs.no_warm_store = True
     if "--trace-out" in sys.argv[1:]:
         # span tracing + Chrome trace export for the whole bench run
         # (docs/observability.md). Flushed explicitly below: os._exit

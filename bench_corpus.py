@@ -79,7 +79,34 @@ def main():
         "write a Chrome trace-event JSON to FILE at exit "
         "(docs/observability.md)",
     )
+    parser.add_argument(
+        "--warm-dir", default=None, metavar="DIR",
+        help="bind the cross-run warm store to DIR/warm "
+        "(support/warm_store.py; MTPU_WARM_DIR overrides) so a "
+        "re-run of this corpus starts from prior proofs/static "
+        "artifacts/routing history — docs/warm_store.md",
+    )
+    parser.add_argument(
+        "--no-warm-store", action="store_true",
+        help="force the cross-run warm store off (same as "
+        "MTPU_WARM=0; bit-for-bit cold behavior)",
+    )
     cli = parser.parse_args()
+    # persistent XLA compile cache, exactly as bench.py main enables
+    # it: lane-path corpus runs otherwise re-pay multi-second kernel
+    # compiles per process, which swamps (and noises) every
+    # cross-process wall comparison this harness exists to make
+    from mythril_tpu.support.devices import enable_compile_cache
+
+    enable_compile_cache()
+    if cli.no_warm_store:
+        from mythril_tpu.support.support_args import args as sargs
+
+        sargs.no_warm_store = True
+    elif cli.warm_dir:
+        from mythril_tpu.support import warm_store
+
+        warm_store.configure(cli.warm_dir)
     if cli.solver_workers is not None:
         from mythril_tpu.smt.solver.pool import configure_pool
 

@@ -242,6 +242,20 @@ class SymExecWrapper:
                 ),
             )
 
+        # cross-run warm store (support/warm_store.py): adopt a prior
+        # run's banks for this code hash ONCE, before execution —
+        # verdicts/facts/bounds replay like a migration sidecar, the
+        # static memo fills cold slots, the cost model seeds
+        # pick_width, and the learned routing table arms. Inert
+        # unless a store directory is configured (MTPU_WARM_DIR or a
+        # corpus/bench --out-dir) and MTPU_WARM=1 (default).
+        try:
+            from ..support import warm_store
+
+            warm_store.begin_analysis(contract)
+        except Exception as e:  # best-effort, never the analysis
+            log.debug("warm-store load failed: %s", e)
+
         # transaction-boundary checkpointing (support/checkpoint.py):
         # install the per-round sink, arm the SIGTERM/fatal live dump,
         # and divert to resume_exec when a loadable snapshot exists

@@ -290,6 +290,15 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
                              "(load in Perfetto; a FILE+'l' JSONL "
                              "twin rides along — "
                              "docs/observability.md)")
+    options.add_argument("--no-warm-store", action="store_true",
+                        help="Disable the cross-run warm store "
+                             "(support/warm_store.py: code-hash-keyed "
+                             "persistence of proofs, static "
+                             "artifacts, and learned solver routing "
+                             "under MTPU_WARM_DIR or a corpus "
+                             "--out-dir/warm). Same as MTPU_WARM=0 — "
+                             "bit-for-bit cold behavior "
+                             "(docs/warm_store.md)")
 
 
 def create_analyzer_parser(parser: argparse.ArgumentParser) -> None:

@@ -365,6 +365,16 @@ class LaserEVM:
                 self._screen_prefetch = self._submit_open_state_screen()
             if self.checkpoint_sink is not None:
                 self.checkpoint_sink(i + 1, self.open_states, address)
+            # cross-run warm store round sink (support/warm_store.py):
+            # the banks proved so far persist under the analyzed
+            # code's hash, so a preempted run still warms the next
+            # submission. Inert unless a store is active.
+            try:
+                from ..support import warm_store
+
+                warm_store.round_sink()
+            except Exception as e:  # best-effort, never the analysis
+                log.debug("warm-store round sink failed: %s", e)
             # cross-host path-batch migration (parallel/migrate.py):
             # a drained corpus rank can take half this round's open
             # states; the bus trims self.open_states in place

@@ -113,6 +113,11 @@ class MythrilAnalyzer:
             # second preemption refreshes the same artifact set
             telemetry.configure(out_dir=resume_dir)
         args.migration_bus = getattr(cmd_args, "migration_bus", None)
+        # --no-warm-store (docs/warm_store.md): stand the cross-run
+        # warm store down for this process, bit-for-bit like
+        # MTPU_WARM=0
+        args.no_warm_store = getattr(cmd_args, "no_warm_store",
+                                     args.no_warm_store)
         # run-wide observability (docs/observability.md): --trace-out
         # arms span tracing and the at-exit Chrome trace export
         args.trace_out = getattr(cmd_args, "trace_out", None)
@@ -184,6 +189,8 @@ class MythrilAnalyzer:
         all_issues: List[Issue] = []
         exceptions = []
         execution_info = None
+        from ..support import warm_store
+
         for contract in self.contracts:
             try:
                 # fresh solver session + keccak axioms per contract:
@@ -210,6 +217,15 @@ class MythrilAnalyzer:
                     "exception during %s analysis", contract.name
                 )
                 exceptions.append(traceback.format_exc())
+            finally:
+                # warm-store final save: the detector-phase proofs
+                # (fired during execution) are settled by now, so the
+                # entry under this code's hash is complete
+                # (support/warm_store.py; no-op when inactive)
+                try:
+                    warm_store.end_analysis()
+                except Exception as e:
+                    log.debug("warm-store save failed: %s", e)
         stats = SolverStatistics()
         if getattr(stats, "enabled", False):
             log.info("solver statistics: %s", stats)

@@ -266,6 +266,14 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     from ..support import telemetry
 
     telemetry.configure(out_dir=str(out), rank=process_id)
+    # cross-run warm store (support/warm_store.py): bind the
+    # code-hash-keyed entry store to --out-dir/warm so re-analyses of
+    # a re-submitted corpus start from prior proofs/static artifacts/
+    # routing history. MTPU_WARM=0 (or --no-warm-store on the
+    # analyzers) keeps behavior bit-for-bit cold.
+    from ..support import warm_store
+
+    warm_store.configure(str(out))
     # cost-aware LPT when a prior run left stats.json in --out-dir,
     # deterministic round-robin otherwise; long-pole contracts above
     # the perfect-balance share are pre-declared splittable so the
@@ -494,6 +502,17 @@ def run_corpus(paths: Sequence[str], out_dir: str, process_id: int,
     from .cost_model import save_stats
 
     save_stats(out, merged["contracts"], telemetry=merged_metrics)
+    # warm-store GC (tools/warm_gc.py is the standalone twin): cap
+    # --out-dir/warm by entry count/age so a long-lived corpus dir
+    # cannot grow without bound (LRU by mtime; env-tunable caps)
+    try:
+        if warm_store.active():  # MTPU_WARM=0 must touch NO store file
+            gc = warm_store.gc_store()
+            if gc.get("removed"):
+                log.info("warm store gc: removed %d entries (%d kept)",
+                         len(gc["removed"]), gc["kept"])
+    except Exception as e:  # housekeeping only
+        log.debug("warm store gc failed: %s", e)
     return merged
 
 

@@ -114,19 +114,40 @@ STATS_NAME = "stats.json"
 _EMA_ALPHA = 0.5
 
 
+def _quarantine_stats(path: Path) -> None:
+    """Move a corrupt stats file aside (``stats.json.corrupt``) so
+    the next save starts from a clean slate instead of re-reading —
+    and re-failing on — the same truncated bytes every run. Best
+    effort; the quarantined copy is kept for post-mortems."""
+    try:
+        os.replace(path, str(path) + ".corrupt")
+        log.warning("quarantined corrupt stats file as %s",
+                    str(path) + ".corrupt")
+    except OSError as e:  # pragma: no cover - fs races only
+        log.debug("stats quarantine failed: %s", e)
+
+
 def load_stats(out_dir) -> Dict[str, dict]:
     """{contract basename: {"wall_s": float, "fork_peak": int}} from a
-    prior run's stats file, or {} when absent/corrupt."""
+    prior run's stats file, or {} when absent. A corrupt/truncated
+    file (a crash mid-write predating the tmp+rename save, or disk
+    damage) is tolerated — scheduling falls back to cold — and
+    QUARANTINED so it cannot shadow every later run."""
     path = Path(out_dir) / STATS_NAME
     try:
         if not path.exists():
             return {}
         data = json.loads(path.read_text())
+        if not isinstance(data, dict):
+            raise ValueError("stats payload is not an object")
         contracts = data.get("contracts", {})
         return {str(k): v for k, v in contracts.items()
                 if isinstance(v, dict)}
+    except (KeyboardInterrupt, MemoryError):
+        raise
     except Exception as e:
         log.warning("stats load failed (%s); scheduling cold", e)
+        _quarantine_stats(path)
         return {}
 
 
@@ -182,10 +203,23 @@ def save_stats(out_dir, results: Sequence[dict],
     if telemetry:
         payload["telemetry"] = telemetry
     try:
+        # atomic tmp+fsync+rename: a SIGTERM (or power loss) mid-write
+        # must never truncate the cost model the next warm-start
+        # schedule reads — the rename only lands a fully-flushed file,
+        # and an interrupted write leaves the previous stats intact
         fd, tmp = tempfile.mkstemp(dir=str(out), prefix=".stats-")
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, out / STATS_NAME)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, out / STATS_NAME)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
     except Exception as e:  # pragma: no cover - best-effort by design
         log.warning("stats save failed (%s)", e)
 

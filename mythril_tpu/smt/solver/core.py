@@ -680,12 +680,47 @@ def check(
     try:
         with trace.span("solver.check", tier=tier, tactic=tactic,
                         n=len(assertions)) as sp:
-            ctx = _check_unmeasured(assertions, timeout_s,
-                                    conflict_budget, minimize,
-                                    maximize, phase_hint, cancel,
-                                    force_oneshot)
+            ctx = None
+            # learned first-try routing (support/warm_store.py,
+            # docs/warm_store.md): a plain satisfiability query whose
+            # SHAPE has enough cross-run history first-tries the
+            # recorded winning tactic at the recorded budget; a
+            # definitive answer skips the full-budget default (and,
+            # on the pooled path, the portfolio race). UNKNOWN falls
+            # back to the untouched default pipeline, so routing can
+            # cost bounded extra wall but never a verdict. The pool's
+            # own tiers consult before calling here (pool.solve_query)
+            # and are excluded, as are optimization/cancellable calls.
+            route = None
+            if (cancel is None and not force_oneshot and not minimize
+                    and not maximize
+                    and tier not in ("pool.first", "pool.race")):
+                try:
+                    from ...support import warm_store
+
+                    route = warm_store.route_for_query(
+                        len(assertions), timeout_s)
+                except (KeyboardInterrupt, MemoryError):
+                    raise  # fatal, never a degrade
+                except Exception:  # a hint, never an error path
+                    route = None
+            if route is not None:
+                r_tactic, r_budget = route
+                ctx = _check_unmeasured(
+                    assertions, r_budget, conflict_budget, (), (),
+                    phase_hint, None, r_tactic == "oneshot")
+                if ctx.status in (SAT, UNSAT):
+                    tactic = "routed." + r_tactic
+                    ss.bump(route_first_try_wins=1)
+                else:
+                    ctx = None  # routed budget exhausted: full path
+            if ctx is None:
+                ctx = _check_unmeasured(assertions, timeout_s,
+                                        conflict_budget, minimize,
+                                        maximize, phase_hint, cancel,
+                                        force_oneshot)
             status = ctx.status
-            sp.set(status=status)
+            sp.set(status=status, tactic=tactic)
         return ctx
     finally:
         wall = time.monotonic() - t_q
@@ -695,6 +730,12 @@ def check(
         try:
             metrics.registry().histogram(
                 "solver_wall_ms." + tactic).observe(wall * 1000.0)
+            # warm-store routing history (cross-run only; inert
+            # unless a store is active — support/warm_store.py)
+            from ...support import warm_store
+
+            warm_store.observe_query(len(assertions), tactic, wall,
+                                     status)
             slowlog.maybe_record(
                 wall * 1000.0, tids=tids, tier=tier, tactic=tactic,
                 timeout_s=timeout_s, status=status)

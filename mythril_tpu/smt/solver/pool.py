@@ -416,7 +416,43 @@ class SolverPool:
     def solve_query(self, work, timeout_s: float, conflict_budget: int):
         """One pooled query: short-budget first attempt on this
         thread's session, then (racing on) the 2-tactic portfolio
-        escalation. Returns a CheckContext."""
+        escalation. Returns a CheckContext.
+
+        With warm-store routing history for this query's shape
+        (support/warm_store.py, docs/warm_store.md) the first attempt
+        uses the LEARNED tactic and budget instead of the fixed short
+        incremental probe, and the race is demoted to the fallback —
+        it only runs when the routed try comes back UNKNOWN. Shapes
+        with no history keep today's escalation bit-for-bit."""
+        from ...support.telemetry import trace
+
+        route = None
+        try:
+            from ...support import warm_store
+
+            route = warm_store.route_for_query(len(work), timeout_s)
+        except (KeyboardInterrupt, MemoryError):
+            raise  # fatal, never a degrade
+        except Exception:  # a hint, never an error path
+            route = None
+        if route is not None:
+            r_tactic, r_budget = route
+            t0 = time.monotonic()
+            with trace.query_context(tier="pool.first",
+                                     tactic="routed." + r_tactic):
+                ctx = core.check(work,
+                                 timeout_s=min(r_budget, timeout_s),
+                                 conflict_budget=conflict_budget,
+                                 force_oneshot=r_tactic == "oneshot")
+            if ctx.status != UNKNOWN:
+                SolverStatistics().bump(route_first_try_wins=1)
+                return ctx
+            if not self.racing:
+                return ctx
+            remaining = max(timeout_s - (time.monotonic() - t0),
+                            0.25 * timeout_s)
+            won = self.race(work, remaining, conflict_budget)
+            return won if won is not None else ctx
         first_to = timeout_s
         first_cb = conflict_budget
         escalate = self.racing and (

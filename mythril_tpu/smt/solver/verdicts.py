@@ -100,12 +100,16 @@ _FACT_CAP = 4096
 
 
 class _Entry:
-    __slots__ = ("verdict", "model", "bounds")
+    __slots__ = ("verdict", "model", "bounds", "stamp")
 
     def __init__(self):
         self.verdict: Optional[str] = None
         self.model = None  # core.ModelData for SAT entries
         self.bounds: Optional[dict] = None  # var_tid -> (var, lo, hi)
+        # write-stamp (monotone per cache): lets the warm store export
+        # only entries touched since a mark instead of re-serializing
+        # the whole run-wide bank at every round sink
+        self.stamp: int = 0
 
 
 class VerdictCache:
@@ -121,6 +125,8 @@ class VerdictCache:
         # dict operations, so stripes would add deadlock surface
         # without removing contention (docs/solver_pool.md).
         self._lock = threading.RLock()
+        # monotone write counter backing _Entry.stamp / _fact_stamps
+        self._stamp = 0
         # ordered tid-tuple -> interned frozenset key (the trie: a
         # child extends its parent prefix's key by the delta tid)
         self._fp: Dict[tuple, frozenset] = {}
@@ -132,6 +138,9 @@ class VerdictCache:
         # consequences of the keyed set (docs/propagation.md), safe to
         # assert ahead of its real constraints in any solver query
         self._facts: "OrderedDict[frozenset, tuple]" = OrderedDict()
+        # fact-bank write stamps (kept beside _facts rather than on
+        # _Entry so note_facts never has to mint LRU entries)
+        self._fact_stamps: Dict[frozenset, int] = {}
 
     # -- fingerprinting ----------------------------------------------------
 
@@ -207,6 +216,8 @@ class VerdictCache:
                         "%s then %s", len(ks), e.verdict, verdict)
             return
         e.verdict = verdict
+        self._stamp += 1
+        e.stamp = self._stamp
         if model is not None and e.model is None:
             e.model = model
         if verdict == UNSAT and index_unsat:
@@ -227,8 +238,11 @@ class VerdictCache:
             return
         self._facts[ks] = tuple(facts)
         self._facts.move_to_end(ks)
+        self._stamp += 1
+        self._fact_stamps[ks] = self._stamp
         while len(self._facts) > _FACT_CAP:
-            self._facts.popitem(last=False)
+            old, _ = self._facts.popitem(last=False)
+            self._fact_stamps.pop(old, None)
 
     @_locked
     def facts_for(self, tids) -> tuple:
@@ -260,6 +274,8 @@ class VerdictCache:
                 _, olo, ohi = old
                 cur[var_tid] = (var, max(lo, olo), min(hi, ohi))
         e.bounds = cur
+        self._stamp += 1
+        e.stamp = self._stamp
 
     # -- tier 1: ancestor-UNSAT subsumption --------------------------------
 
@@ -530,6 +546,69 @@ class VerdictCache:
         entries = list(out.values())
         SolverStatistics().verdicts_shipped += len(entries)
         return entries
+
+    @_locked
+    def mark(self) -> int:
+        """Current write-stamp: pass to export_all_entries(since=...)
+        to export only entries recorded/banked after this point (the
+        warm store marks at analysis start, so one contract's entry
+        carries ITS banks — imported ones re-stamp on import — not a
+        whole corpus rank's accumulation)."""
+        return self._stamp
+
+    @_locked
+    def export_all_entries(self, cap: int = 4096,
+                           since: int = 0) -> List:
+        """EVERY banked proof/fact/bound as export_entries 5-tuples,
+        newest first up to ``cap`` — the warm-store save seam
+        (support/warm_store.py). Unlike export_entries this is not
+        restricted to given states' prefixes: the cache is run-wide
+        and verdicts are term-level facts, so an entry minted while
+        another contract was in flight is sound to replay anywhere
+        (it simply never matches foreign term sets). Only proofs can
+        exist here — record() refuses anything but SAT/UNSAT, and a
+        timeout never enters — so the proofs-only persistence
+        invariant is inherited, not re-checked. ``since`` filters to
+        entries written after a mark() point. Entries whose terms
+        have left the tid index (cannot happen for interned terms,
+        but guarded) are skipped whole."""
+        from .. import terms as T
+
+        out: List = []
+        fact_only = [ks for ks in self._facts
+                     if ks not in self._entries]
+        entry_keys = list(self._entries.keys())
+        entry_keys.reverse()  # LRU order: most-recently-used first
+        for ks in entry_keys + fact_only:
+            if len(out) >= cap:
+                break
+            e = self._entries.get(ks)
+            if since and max(
+                    e.stamp if e is not None else 0,
+                    self._fact_stamps.get(ks, 0)) <= since:
+                continue
+            verdict = e.verdict if e is not None \
+                and e.verdict in (SAT, UNSAT) else None
+            facts = tuple(self._facts.get(ks, ()))
+            bounds = ()
+            if e is not None and e.bounds:
+                bounds = tuple((var, lo, hi)
+                               for var, lo, hi in e.bounds.values())
+            if verdict is None and not facts and not bounds:
+                continue
+            ordered = []
+            for tid in sorted(ks):
+                t = T.term_by_tid(tid)
+                if t is None:
+                    ordered = None
+                    break
+                ordered.append(t)
+            if not ordered:
+                continue
+            out.append((ordered, verdict,
+                        _slim_model(e.model) if e is not None
+                        else None, facts, bounds))
+        return out
 
     @_locked
     def import_entries(self, entries: Sequence) -> int:

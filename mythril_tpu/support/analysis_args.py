@@ -31,6 +31,7 @@ def make_cmd_args(**overrides) -> SimpleNamespace:
         checkpoint=None,
         resume=None,
         migration_bus=None,
+        no_warm_store=False,
     )
     unknown = set(overrides) - set(base)
     if unknown:

@@ -297,6 +297,18 @@ def save_static_sidecar(path, entries) -> bool:
         return False
 
 
+def filter_static_entries(entries) -> list:
+    """Current-shape StaticInfo entries only: the per-entry field
+    probe shared by the migration static sidecar and the warm store
+    (support/warm_store.py) — a stale-shape entry would resolve new
+    consumers' getattr probes to class defaults, silently turning the
+    newer layers off for that code."""
+    return [e for e in entries
+            if hasattr(e, "code_hash") and hasattr(e, "reach_mask")
+            and hasattr(e, "taint_converged")
+            and hasattr(e, "loop_templates")]
+
+
 def load_static_sidecar(path) -> list:
     """Inverse of save_static_sidecar; absent/corrupt loads as empty
     (the thief re-analyzes — milliseconds, never wrong). A payload
@@ -318,10 +330,7 @@ def load_static_sidecar(path) -> list:
                      else "legacy-list", STATIC_SIDECAR_SHAPE)
             return []
         entries = list(payload.get("entries", ()))
-        kept = [e for e in entries
-                if hasattr(e, "code_hash") and hasattr(e, "reach_mask")
-                and hasattr(e, "taint_converged")
-                and hasattr(e, "loop_templates")]
+        kept = filter_static_entries(entries)
         if len(kept) != len(entries):
             log.info("static sidecar: dropped %d stale-shape "
                      "entries (thief re-analyzes)",

@@ -54,6 +54,10 @@ class Args(object, metaclass=Singleton):
         # run-wide span tracer (support/telemetry/,
         # docs/observability.md); None = no export
         self.trace_out = None
+        # --no-warm-store: force the cross-run warm store off for
+        # this process (support/warm_store.py, docs/warm_store.md) —
+        # same effect as MTPU_WARM=0, bit-for-bit cold behavior
+        self.no_warm_store = False
 
 
 args = Args()

@@ -105,6 +105,16 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("spill_merged", "spill_merged_lanes"),
         ("ring_high_water", "ring_high_water"),
     )),
+    ("Warm store", "docs/warm_store.md",
+     ("warm_hits", "warm_misses", "verdicts_warmed",
+      "static_warmed", "route_first_try_wins"), (
+        ("hits", "warm_hits"),
+        ("misses", "warm_misses"),
+        ("verdicts_warmed", "verdicts_warmed"),
+        ("facts_warmed", "facts_warmed"),
+        ("static_warmed", "static_warmed"),
+        ("route_wins", "route_first_try_wins"),
+    )),
     ("Checkpoint/resume", "docs/checkpoint.md",
      ("lanes_exported", "lanes_imported", "midflight_steals",
       "resume_rounds"), (

@@ -44,3 +44,22 @@ def _fresh_execution_deadline():
     time_handler.clear()
     yield
     time_handler.clear()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warm_store():
+    """Reset the cross-run warm store's in-process state around every
+    test (support/warm_store.py).
+
+    The store is DESIGNED to persist banks across analyses in one
+    process — which is exactly wrong between tests: a corpus-mode test
+    configures the store against its tmp out-dir, and without this
+    reset every later analysis in the session would silently save
+    into (and warm-load from) that stale directory, coupling test
+    outcomes to suite order the same way the deadline leak above did.
+    """
+    from mythril_tpu.support import warm_store
+
+    warm_store.reset()
+    yield
+    warm_store.reset()

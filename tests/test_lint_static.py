@@ -324,3 +324,57 @@ def test_solver_import_outside_static_pass_ok(tmp_path):
         from ..smt.solver import core
     """)
     assert findings == []
+
+
+def test_warm_store_env_resolution_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/bad_warm.py", """\
+        import os
+
+        def my_dir():
+            return os.environ.get("MTPU_WARM_DIR", "/tmp/warm")
+    """)
+    assert [f.rule for f in findings] == ["warm-store-io-outside-module"]
+
+
+def test_warm_store_io_helper_call_flagged(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/parallel/bad_warm2.py", """\
+        from ..support import warm_store
+
+        def peek(key):
+            return warm_store._read_entry(key)
+
+        def base():
+            return warm_store.store_dir()
+    """)
+    assert [f.rule for f in findings] == [
+        "warm-store-io-outside-module"] * 2
+
+
+def test_warm_store_module_itself_exempt(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/support/warm_store.py", """\
+        import os
+
+        def store_dir():
+            return os.environ.get("MTPU_WARM_DIR")
+    """)
+    assert findings == []
+
+
+def test_warm_store_high_level_api_ok(tmp_path):
+    """Consumers of the sanctioned API (and docstrings/help text that
+    merely MENTION the env var inside longer strings) are clean."""
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/parallel/good_warm.py", """\
+        from ..support import warm_store
+
+        def run(out_dir, contract):
+            '''Uses MTPU_WARM_DIR via the store module only.'''
+            warm_store.configure(out_dir)
+            warm_store.begin_analysis(contract)
+            warm_store.round_sink()
+            warm_store.end_analysis()
+            return warm_store.gc_store(path=out_dir)
+    """)
+    assert findings == []

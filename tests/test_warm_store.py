@@ -1,0 +1,454 @@
+"""Cross-run warm store (support/warm_store.py, docs/warm_store.md):
+store integrity (version/shape/hash/corruption drop-whole), the
+proofs-only persistence invariant, bank adoption counters, learned
+first-try routing, GC, the hardened stats.json, and a two-process
+cold->warm corpus run gating issue identity with warmed banks."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from mythril_tpu.smt import ULE, ULT, symbol_factory
+from mythril_tpu.smt.solver import verdicts as verdict_mod
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support import warm_store
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class _FakeContract:
+    """Minimal contract shape for code_key/begin_analysis."""
+
+    creation_code = ""
+    code = "60016002015b00"
+    disassembly = None
+
+
+def _bank_two_proofs():
+    """Record one UNSAT pair + one SAT prefix in the run-wide cache;
+    returns the raw terms."""
+    vc = verdict_mod.cache()
+    x = symbol_factory.BitVecSym("ws_x", 256)
+    lo = ULT(x, symbol_factory.BitVecVal(4, 256)).raw
+    hi = ULE(symbol_factory.BitVecVal(9, 256), x).raw
+    vc.record((lo.tid, hi.tid), verdict_mod.UNSAT)
+    vc.record((lo.tid,), verdict_mod.SAT)
+    return lo, hi
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    """An active warm store bound to tmp_path (MTPU_WARM default-on
+    path; the conftest autouse fixture resets module state after)."""
+    monkeypatch.delenv("MTPU_WARM", raising=False)
+    monkeypatch.delenv("MTPU_WARM_DIR", raising=False)
+    warm_store.reset()
+    warm_store.configure(tmp_path)
+    verdict_mod.reset_cache()
+    yield tmp_path / "warm"
+    verdict_mod.reset_cache()
+
+
+def _save_entry(contract=None, bank=_bank_two_proofs):
+    """Cold begin -> bank proofs -> save -> end, returning (key,
+    banked terms). Banking happens AFTER begin_analysis: the store
+    marks the verdict cache at analysis start and exports only what
+    the bracketed analysis recorded (plus imported banks)."""
+    contract = contract or _FakeContract()
+    assert warm_store.begin_analysis(contract) is False  # cold
+    banked = bank() if bank else None
+    assert warm_store._save_current()
+    key = warm_store.code_key(contract)
+    warm_store.end_analysis()
+    return key, banked
+
+
+def _rewrite_payload(store_dir, key, mutate):
+    """Load a saved entry's payload, apply ``mutate``, write it back
+    (through the checkpoint helpers — the same framing the store
+    uses)."""
+    from mythril_tpu.support.checkpoint import (
+        dump_with_terms, load_with_terms,
+    )
+
+    path = Path(store_dir) / (key + ".warm")
+    with open(path, "rb") as f:
+        payload = load_with_terms(f)
+    mutate(payload)
+    with open(path, "wb") as f:
+        dump_with_terms(f, payload)
+
+
+def test_roundtrip_adopts_banks_and_counts(store):
+    def bank():
+        pair = _bank_two_proofs()
+        verdict_mod.cache().note_facts((pair[0].tid,), (pair[0],))
+        return pair
+
+    key, (lo, hi) = _save_entry(bank=bank)
+    assert (store / (key + ".warm")).exists()
+
+    verdict_mod.reset_cache()
+    ss = SolverStatistics()
+    h0, v0, f0 = ss.warm_hits, ss.verdicts_warmed, ss.facts_warmed
+    assert warm_store.begin_analysis(_FakeContract()) is True
+    assert ss.warm_hits == h0 + 1
+    assert ss.verdicts_warmed - v0 >= 2
+    assert ss.facts_warmed - f0 >= 1
+    vc2 = verdict_mod.cache()
+    assert vc2.probe([lo, hi])[0] == verdict_mod.UNSAT
+    assert vc2.probe([lo])[0] == verdict_mod.SAT
+    assert vc2.facts_for((lo.tid,)) == (lo,)
+
+
+def test_version_skew_drops_whole(store):
+    key, _ = _save_entry()
+    _rewrite_payload(store, key, lambda p: p.update(
+        version=warm_store.STORE_VERSION + 1))
+    verdict_mod.reset_cache()
+    ss = SolverStatistics()
+    m0, v0 = ss.warm_misses, ss.verdicts_warmed
+    assert warm_store.begin_analysis(_FakeContract()) is False
+    assert ss.warm_misses == m0 + 1
+    assert ss.verdicts_warmed == v0  # nothing partially adopted
+
+
+def test_static_shape_skew_drops_whole(store):
+    key, _ = _save_entry()
+    _rewrite_payload(store, key, lambda p: p.update(
+        static_shape=p["static_shape"] + 1))
+    verdict_mod.reset_cache()
+    ss = SolverStatistics()
+    v0 = ss.verdicts_warmed
+    assert warm_store.begin_analysis(_FakeContract()) is False
+    assert ss.verdicts_warmed == v0
+
+
+def test_truncated_entry_drops_whole(store):
+    key, _ = _save_entry()
+    path = store / (key + ".warm")
+    blob = path.read_bytes()
+    path.write_bytes(blob[: len(blob) // 2])
+    verdict_mod.reset_cache()
+    ss = SolverStatistics()
+    m0 = ss.warm_misses
+    assert warm_store.begin_analysis(_FakeContract()) is False
+    assert ss.warm_misses == m0 + 1
+
+
+def test_foreign_code_hash_rejected(store):
+    """A renamed/moved entry whose recorded hash disagrees with the
+    requested key must never be trusted."""
+    key, _ = _save_entry()
+
+    class Other(_FakeContract):
+        code = "challenge-different-code"
+
+    other_key = warm_store.code_key(Other())
+    (store / (key + ".warm")).rename(store / (other_key + ".warm"))
+    verdict_mod.reset_cache()
+    assert warm_store.begin_analysis(Other()) is False
+    assert warm_store._read_entry(other_key) is None
+
+
+def test_proofs_only_never_a_timeout(store):
+    """A timeout/UNKNOWN verdict can neither enter the cache nor the
+    store, and a hand-crafted on-disk 'unknown' is not adopted as a
+    proof."""
+    contract = _FakeContract()
+    assert warm_store.begin_analysis(contract) is False
+    lo, hi = _bank_two_proofs()
+    vc = verdict_mod.cache()
+    vc.record((hi.tid,), verdict_mod.UNKNOWN)  # refused by record()
+    entries = vc.export_all_entries()
+    assert entries, "proofs must export"
+    assert all(e[1] in (verdict_mod.SAT, verdict_mod.UNSAT, None)
+               for e in entries)
+    assert not any([t.tid for t in e[0]] == [hi.tid] and e[1]
+                   for e in entries)
+
+    assert warm_store._save_current()
+    key = warm_store.code_key(contract)
+    warm_store.end_analysis()
+
+    def plant_unknown(p):
+        p["verdicts"] = [([hi], "unknown", None, (), ())]
+
+    _rewrite_payload(store, key, plant_unknown)
+    verdict_mod.reset_cache()
+    ss = SolverStatistics()
+    v0 = ss.verdicts_warmed
+    warm_store.begin_analysis(_FakeContract())
+    assert ss.verdicts_warmed == v0  # an unknown is not a proof
+    assert verdict_mod.cache().probe([hi])[0] is None
+
+
+def test_off_really_off(store, monkeypatch):
+    """MTPU_WARM=0: no load, no save, no store file touched."""
+    monkeypatch.setenv("MTPU_WARM", "0")
+    _bank_two_proofs()
+    assert warm_store.active() is False
+    assert warm_store.begin_analysis(_FakeContract()) is False
+    warm_store.round_sink()
+    warm_store.end_analysis()
+    assert not (store).exists()  # the warm/ dir was never created
+    ss = SolverStatistics()
+    assert warm_store.route_for_query(2, 10.0) is None
+
+
+def test_no_warm_store_arg_stands_down(store, monkeypatch):
+    from mythril_tpu.support.support_args import args
+
+    monkeypatch.setattr(args, "no_warm_store", True)
+    assert warm_store.enabled() is False
+    assert warm_store.begin_analysis(_FakeContract()) is False
+    assert not store.exists()
+
+
+def test_round_sink_persists_mid_analysis(store):
+    contract = _FakeContract()
+    warm_store.begin_analysis(contract)
+    _bank_two_proofs()
+    warm_store.round_sink()
+    key = warm_store.code_key(contract)
+    assert (store / (key + ".warm")).exists()
+    warm_store.end_analysis()
+
+
+# -- learned solver routing ----------------------------------------------
+
+
+def test_route_for_query_selection_and_budget(store):
+    warm_store._ACTIVE = True
+    warm_store._ROUTES_LOADED.clear()
+    # not enough samples -> no route
+    warm_store._ROUTES_LOADED["n4"] = {
+        "oneshot": {"n": 2, "definitive": 2, "walls_ms": [10.0, 12.0]}}
+    assert warm_store.route_for_query(3, 10.0) is None
+    # mostly-timeout shape -> no route (a routed short try would only
+    # add wall on a shape the budget cannot settle)
+    warm_store._ROUTES_LOADED["n4"] = {
+        "incremental": {"n": 10, "definitive": 2,
+                        "walls_ms": [10.0, 12.0]}}
+    assert warm_store.route_for_query(3, 10.0) is None
+    # healthy history -> tactic with the better definitive ratio wins,
+    # budget = clamp(2 x p90)
+    warm_store._ROUTES_LOADED["n4"] = {
+        "incremental": {"n": 10, "definitive": 7,
+                        "walls_ms": [100.0] * 10},
+        "oneshot": {"n": 10, "definitive": 10,
+                    "walls_ms": [200.0] * 10},
+    }
+    tactic, budget = warm_store.route_for_query(3, 10.0)
+    assert tactic == "oneshot"
+    assert budget == pytest.approx(0.4)  # 2 x 200 ms
+    # the budget clamps into [min, max] and never exceeds a quarter
+    # of the caller's timeout (the 25% misprediction-waste bound)
+    warm_store._ROUTES_LOADED["n4"]["oneshot"]["walls_ms"] = [1.0] * 10
+    assert warm_store.route_for_query(3, 10.0)[1] == \
+        warm_store.ROUTE_BUDGET_MIN_S
+    warm_store._ROUTES_LOADED["n4"]["oneshot"]["walls_ms"] = [9e6] * 10
+    assert warm_store.route_for_query(3, 0.5)[1] == \
+        pytest.approx(0.125)
+    assert warm_store.route_for_query(3, 40.0)[1] == \
+        warm_store.ROUTE_BUDGET_MAX_S
+
+
+def test_route_knobs_stand_down(store, monkeypatch):
+    """MTPU_WARM_ROUTE=0 keeps banks warm but disables first-try
+    routing; MTPU_WARM_COST=0 skips only the width warm start."""
+    warm_store._ACTIVE = True
+    warm_store._ROUTES_LOADED["n4"] = {
+        "oneshot": {"n": 8, "definitive": 8, "walls_ms": [50.0] * 8}}
+    assert warm_store.route_for_query(3, 10.0) is not None
+    monkeypatch.setenv("MTPU_WARM_ROUTE", "0")
+    assert warm_store.route_for_query(3, 10.0) is None
+
+
+def test_observe_only_feeds_fresh_never_consult(store):
+    """In-run observations accumulate for the SAVE side only — the
+    consult table is cross-run history, so cold-path behavior never
+    depends on this process's own earlier queries."""
+    warm_store._ACTIVE = True
+    for _ in range(10):
+        warm_store.observe_query(3, "oneshot", 0.01, "sat")
+    assert warm_store.route_for_query(3, 10.0) is None
+    shape = warm_store.query_shape(3)
+    assert warm_store._ROUTES_FRESH[shape]["oneshot"]["n"] == 10
+    merged = warm_store.export_routes()
+    assert merged[shape]["oneshot"]["definitive"] == 10
+
+
+def test_routed_first_try_wins_and_verdict_parity(store):
+    """A routed first try settles the query (counter bumps) and its
+    verdict matches the unrouted default path."""
+    from mythril_tpu.smt.solver import core
+
+    warm_store._ACTIVE = True
+    x = symbol_factory.BitVecSym("ws_route_x", 256)
+    work = [ULE(symbol_factory.BitVecVal(5, 256), x).raw,
+            ULT(x, symbol_factory.BitVecVal(3, 256)).raw]  # UNSAT
+    shape = warm_store.query_shape(len(work))
+    warm_store._ROUTES_LOADED[shape] = {
+        "oneshot": {"n": 8, "definitive": 8, "walls_ms": [50.0] * 8}}
+    ss = SolverStatistics()
+    w0 = ss.route_first_try_wins
+    routed = core.check(work, timeout_s=5.0)
+    assert ss.route_first_try_wins == w0 + 1
+    warm_store._ROUTES_LOADED.clear()
+    direct = core.check(work, timeout_s=5.0)
+    assert routed.status == direct.status == core.UNSAT
+
+
+def test_routing_survives_save_load(store):
+    warm_store._ACTIVE = True
+    for _ in range(5):
+        warm_store.observe_query(3, "oneshot", 0.02, "sat")
+    key, _ = _save_entry(bank=None)
+    warm_store._ROUTES_FRESH.clear()
+    warm_store._ROUTES_LOADED.clear()
+    verdict_mod.reset_cache()
+    assert warm_store.begin_analysis(_FakeContract()) is True
+    assert warm_store.route_for_query(3, 10.0) is not None
+
+
+# -- garbage collection --------------------------------------------------
+
+
+def test_gc_caps_by_count_and_age(tmp_path):
+    d = tmp_path / "warm"
+    d.mkdir()
+    now = time.time()
+    for i in range(6):
+        f = d / (f"{i:064x}.warm")
+        f.write_bytes(b"x")
+        os.utime(f, (now - i * 1000, now - i * 1000))
+    out = warm_store.gc_store(path=d, max_entries=3,
+                              max_age_days=None, dry_run=True)
+    assert out["dry_run"] and len(out["removed"]) == 3
+    assert len(list(d.glob("*.warm"))) == 6  # dry run deletes nothing
+    out = warm_store.gc_store(path=d, max_entries=3, max_age_days=None)
+    assert len(out["removed"]) == 3 and out["kept"] == 3
+    survivors = sorted(f.name for f in d.glob("*.warm"))
+    # LRU by mtime: the three NEWEST (smallest i) survive
+    assert survivors == sorted(f"{i:064x}.warm" for i in range(3))
+    # age cap: everything older than ~0 days goes
+    old = d / ("f" * 64 + ".warm")
+    old.write_bytes(b"x")
+    os.utime(old, (now - 10 * 86400, now - 10 * 86400))
+    out = warm_store.gc_store(path=d, max_entries=None, max_age_days=5)
+    assert old.name in out["removed"]
+
+
+def test_warm_gc_tool_cli(tmp_path):
+    d = tmp_path / "warm"
+    d.mkdir()
+    (d / ("a" * 64 + ".warm")).write_bytes(b"x")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "warm_gc.py"), str(d),
+         "--max-entries", "0", "--dry-run"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["dry_run"] and len(summary["removed"]) == 1
+
+
+# -- hardened stats.json (parallel/cost_model.py) ------------------------
+
+
+def test_corrupt_stats_tolerated_and_quarantined(tmp_path):
+    from mythril_tpu.parallel import cost_model
+
+    stats_file = tmp_path / cost_model.STATS_NAME
+    stats_file.write_text('{"contracts": {"a.sol.o": {"wall_s"')
+    assert cost_model.load_stats(tmp_path) == {}
+    assert not stats_file.exists()  # quarantined, not left to re-fail
+    assert (tmp_path / (cost_model.STATS_NAME + ".corrupt")).exists()
+    # the next save starts clean and round-trips
+    cost_model.save_stats(tmp_path, [{"contract": "a.sol.o",
+                                      "wall_s": 1.5}])
+    stats = cost_model.load_stats(tmp_path)
+    assert stats["a.sol.o"]["wall_s"] == 1.5
+
+
+def test_stats_save_is_atomic_tmp_rename(tmp_path):
+    """An aborted write must leave the previous stats intact — the
+    payload only lands via rename of a fully-written tmp file."""
+    from mythril_tpu.parallel import cost_model
+
+    cost_model.save_stats(tmp_path, [{"contract": "a.sol.o",
+                                      "wall_s": 2.0}])
+    before = (tmp_path / cost_model.STATS_NAME).read_text()
+    real_replace = os.replace
+
+    def boom(src, dst):
+        if str(dst).endswith(cost_model.STATS_NAME):
+            raise OSError("disk gone")
+        return real_replace(src, dst)
+
+    try:
+        os.replace = boom
+        cost_model.save_stats(tmp_path, [{"contract": "a.sol.o",
+                                          "wall_s": 99.0}])
+    finally:
+        os.replace = real_replace
+    assert (tmp_path / cost_model.STATS_NAME).read_text() == before
+    assert not list(tmp_path.glob(".stats-*"))  # tmp cleaned up
+
+
+# -- two-process cold -> warm --------------------------------------------
+
+
+def _corpus_run(out_dir, fixture, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MTPU_WARM_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+         "--out-dir", str(out_dir), "--timeout", "60", str(fixture)],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads((Path(out_dir) / "corpus_report.json")
+                      .read_text())
+
+
+def _canon(report):
+    return [(c["contract"], c.get("issues"), c.get("swc"))
+            for c in report["contracts"]]
+
+
+def test_two_process_cold_then_warm_identity(tmp_path):
+    """The acceptance shape: a cold process analyzes a fixture and
+    persists its banks; a SECOND process over the same --out-dir
+    reports identical issues with verdicts_warmed > 0 and a strictly
+    smaller solver-query count."""
+    from tests.fixture_paths import INPUTS
+
+    fixture = INPUTS / "suicide.sol.o"
+    out = tmp_path / "out"
+
+    def query_count(report):
+        hists = report["shards"][0]["metrics"]["histograms"]
+        return sum(h["count"] for name, h in hists.items()
+                   if name.startswith("solver_wall_ms."))
+
+    cold = _corpus_run(out, fixture)
+    cold_solver = cold["shards"][0]["solver"]
+    assert cold_solver["warm_misses"] == 1
+    assert (out / "warm").is_dir() and list((out / "warm")
+                                            .glob("*.warm"))
+    warm = _corpus_run(out, fixture)
+    warm_solver = warm["shards"][0]["solver"]
+    assert _canon(warm) == _canon(cold)
+    assert warm_solver["warm_hits"] == 1
+    assert warm_solver["verdicts_warmed"] > 0
+    assert warm_solver["static_warmed"] > 0
+    assert query_count(warm) < query_count(cold)

@@ -82,6 +82,22 @@ all of it and silently fork the solver-state assumptions the batch
 layer maintains. ``batch`` / ``verdicts`` / ``solver_statistics``
 imports stay sanctioned.
 
+Rule 8 — warm-store-io-outside-module (the PR-13 cross-run-store
+class): reading or writing the cross-run warm store outside
+``mythril_tpu/support/warm_store.py`` — resolving the store location
+(an exact ``"MTPU_WARM_DIR"`` env key in any call, or a call to the
+store's path/IO helpers ``store_dir`` / ``_entry_path`` /
+``_read_entry`` / ``_write_entry``). The store's trust boundary
+(version framing, static-shape gating, foreign-hash rejection,
+proofs-only persistence — docs/warm_store.md) lives entirely in that
+module, the same one-sanctioned-seam shape as rule 5's raw-pickle
+ban: an ad-hoc reader would adopt entries without the drop-whole
+validation, and an ad-hoc writer would emit entries the validator
+rejects (or worse, accepts without having earned trust). Consumers
+use the high-level API (configure / begin_analysis / round_sink /
+end_analysis / route_for_query / gc_store) — or allowlist with a
+reason.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -175,6 +191,44 @@ _RULE7_BANNED_TAILS = (("smt", "solver", "core"),
                        ("smt", "solver", "pool"),
                        ("native",))
 _RULE7_BANNED_NAMES = frozenset(("core", "pool", "SatSolver"))
+
+
+#: rule-8: the one module allowed to resolve/read/write warm-store
+#: entries (it IS the trust boundary), the path/IO helper names banned
+#: elsewhere, and the store-location env key whose exact use marks an
+#: ad-hoc resolver
+_RULE8_EXEMPT = "mythril_tpu/support/warm_store.py"
+_RULE8_IO_FNS = frozenset(
+    ("store_dir", "_entry_path", "_read_entry", "_write_entry"))
+_RULE8_ENV_KEY = "MTPU_WARM_DIR"
+
+
+def _rule8_findings(rel: str, tree) -> List["Finding"]:
+    out: List[Finding] = []
+
+    def flag(node, what):
+        out.append(Finding(
+            rel, node.lineno, "warm-store-io-outside-module",
+            "warm-store {} outside support/warm_store.py — the "
+            "version/shape/hash validation and proofs-only invariant "
+            "live there; use the high-level API (begin_analysis/"
+            "round_sink/end_analysis/route_for_query/gc_store) or "
+            "allowlist with a reason".format(what)))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name in _RULE8_IO_FNS:
+            flag(node, "path/IO helper call ({})".format(name))
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        if any(isinstance(a, ast.Constant)
+               and a.value == _RULE8_ENV_KEY for a in args):
+            flag(node, "location resolution (MTPU_WARM_DIR)")
+    return out
 
 
 def _mod_parts(module) -> tuple:
@@ -395,6 +449,9 @@ def lint_file(path: Path) -> List[Finding]:
 
     if rel.startswith(_RULE7_ROOT):
         out.extend(_rule7_findings(rel, tree))
+
+    if rel.startswith("mythril_tpu/") and rel != _RULE8_EXEMPT:
+        out.extend(_rule8_findings(rel, tree))
 
     if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
         for node in ast.walk(tree):

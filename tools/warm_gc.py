@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Garbage-collect a cross-run warm store (docs/warm_store.md).
+
+The store (``--out-dir/warm/`` or ``MTPU_WARM_DIR``) holds one
+``<sha256>.warm`` entry per analyzed code hash; every completed
+analysis rewrites its entry, so mtime tracks useful recency. This tool
+caps the store by entry count and/or age — LRU by mtime — exactly the
+policy the corpus runner applies automatically after each merge
+(``warm_store.gc_store``); run it standalone against long-lived daemon
+or CI store directories.
+
+    python tools/warm_gc.py DIR [--max-entries N] [--max-age-days D]
+                                [--dry-run]
+
+``--dry-run`` prints what WOULD be removed without unlinking. Exit 0
+always (a GC failure must never fail a pipeline); the summary prints
+as one JSON line.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("dir", help="warm-store directory (the "
+                        "warm/ dir itself, e.g. out/warm)")
+    parser.add_argument("--max-entries", type=int, default=None,
+                        help="keep at most N newest entries "
+                        "(default: $MTPU_WARM_MAX_ENTRIES or 512)")
+    parser.add_argument("--max-age-days", type=float, default=None,
+                        help="drop entries older than D days "
+                        "(default: $MTPU_WARM_MAX_AGE_DAYS or "
+                        "unlimited)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="report removals without unlinking")
+    args = parser.parse_args(argv)
+
+    from mythril_tpu.support import warm_store
+
+    summary = warm_store.gc_store(
+        path=args.dir, max_entries=args.max_entries,
+        max_age_days=args.max_age_days, dry_run=args.dry_run)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
