@@ -58,6 +58,50 @@ def analyze_one(path: Path, timeout: int, tpu_lanes: int = 0):
     }
 
 
+def main_daemon(cli) -> int:
+    """--daemon mode: the same corpus, every fixture submitted to a
+    resident daemon; rows keep the in-process schema (contract /
+    wall_s / issues / swc) so reports diff directly against the
+    one-shot sweep — the BENCH_r12 identity gate."""
+    from mythril_tpu.daemon.client import DaemonClient, DaemonError
+
+    client = DaemonClient(cli.daemon)
+    fixtures = sorted(INPUTS.glob("*.sol.o"))
+    if not fixtures:
+        print(f"no *.sol.o fixtures under {INPUTS}", file=sys.stderr)
+        return 1
+    results = []
+    t0 = time.perf_counter()
+    for path in fixtures:
+        try:
+            row = client.analyze(
+                path.read_text().strip(),
+                bin_runtime=path.name not in CREATION_FIXTURES,
+                name=path.name, timeout=cli.timeout,
+                tpu_lanes=cli.tpu_lanes)
+            r = {"contract": path.name, "wall_s": row["wall_s"],
+                 "issues": row["issue_count"],
+                 "swc": sorted({i["swc-id"] for i in row["issues"]})}
+        except (DaemonError, OSError) as e:
+            r = {"contract": path.name, "error": type(e).__name__}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    total = time.perf_counter() - t0
+    agg = {
+        "corpus": len(results),
+        "total_wall_s": round(total, 1),
+        "total_issues": sum(r.get("issues", 0) for r in results),
+        "errors": sum(1 for r in results if "error" in r),
+        "daemon": cli.daemon,
+    }
+    try:
+        agg["daemon_state"] = client.ping()
+    except (DaemonError, OSError):
+        pass
+    print(json.dumps(agg))
+    return 0
+
+
 def main():
     import argparse
 
@@ -91,7 +135,17 @@ def main():
         help="force the cross-run warm store off (same as "
         "MTPU_WARM=0; bit-for-bit cold behavior)",
     )
+    parser.add_argument(
+        "--daemon", default=None, metavar="SOCK",
+        help="submit every fixture to a resident `myth serve` daemon "
+        "on SOCK instead of analyzing in-process (docs/daemon.md): "
+        "the daemon's warm jit caches/solver sessions/warm store "
+        "serve the whole corpus, and each row reports the daemon's "
+        "request wall",
+    )
     cli = parser.parse_args()
+    if cli.daemon:
+        return main_daemon(cli)
     # persistent XLA compile cache, exactly as bench.py main enables
     # it: lane-path corpus runs otherwise re-pay multi-second kernel
     # compiles per process, which swamps (and noises) every

@@ -46,6 +46,7 @@ COMMAND_LIST = (
         "list-detectors",
         "function-to-hash",
         "hash-to-address",
+        "serve",
         "version",
         "help",
     )
@@ -299,6 +300,16 @@ def add_analysis_args(options: argparse._ArgumentGroup) -> None:
                              "--out-dir/warm). Same as MTPU_WARM=0 — "
                              "bit-for-bit cold behavior "
                              "(docs/warm_store.md)")
+    options.add_argument("--daemon", metavar="SOCK", default=None,
+                        help="Submit this analysis to a resident "
+                             "`myth serve` daemon listening on SOCK "
+                             "instead of analyzing in-process, and "
+                             "stream back the report (warm jit "
+                             "caches, hot solver sessions, shared "
+                             "warm store — docs/daemon.md). Also "
+                             "settable via MTPU_DAEMON; unset/empty "
+                             "keeps the one-shot path bit-for-bit. "
+                             "Bytecode inputs (-c/-f) only")
 
 
 def create_analyzer_parser(parser: argparse.ArgumentParser) -> None:
@@ -414,6 +425,30 @@ def main() -> None:
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
     create_read_storage_parser(read_storage_parser)
+
+    serve_parser = subparsers.add_parser(
+        "serve",
+        help="Run a resident analysis daemon: a long-lived process "
+             "serving `myth analyze --daemon SOCK` submissions with "
+             "warm jit caches, hot solver sessions, and one shared "
+             "warm store (docs/daemon.md)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    serve_parser.add_argument("--out-dir", required=True,
+                              metavar="DIR",
+                              help="daemon state root: the socket "
+                                   "(DIR/daemon.sock), shared warm "
+                                   "store (DIR/warm), cost model "
+                                   "(DIR/stats.json), per-request "
+                                   "artifacts (DIR/requests/), and "
+                                   "the resumable queue")
+    serve_parser.add_argument("--socket", metavar="SOCK", default=None,
+                              help="listen on SOCK instead of "
+                                   "DIR/daemon.sock")
+    serve_parser.add_argument("--workers", type=int, default=1,
+                              help="concurrent analysis workers "
+                                   "(K=1 default per the single-CPU "
+                                   "pool policy)")
 
     subparsers.add_parser(
         "list-detectors",
@@ -652,6 +687,72 @@ def contract_hash_to_address(args: argparse.Namespace) -> None:
     sys.exit(0)
 
 
+def _try_daemon_analyze(args: argparse.Namespace) -> bool:
+    """Route an eligible analyze invocation through a resident daemon
+    (docs/daemon.md). Returns True when the request was fully served
+    (output printed, exit via sys.exit); False when no daemon is
+    configured or the input shape needs the one-shot path — which then
+    runs bit-for-bit as before (the MTPU_DAEMON master-gate
+    contract)."""
+    if args.command not in ANALYZE_LIST:
+        return False
+    from ..daemon import configured_socket
+
+    sock = configured_socket(args.__dict__.get("daemon"))
+    if not sock:
+        return False
+    if args.__dict__.get("graph") or args.__dict__.get(
+            "statespace_json"):
+        log.warning("--daemon serves reports only, not graph/"
+                    "statespace dumps; analyzing one-shot")
+        return False
+    code = None
+    if args.__dict__.get("code"):
+        code = args.code
+    elif args.__dict__.get("codefile"):
+        code = "".join(
+            l.strip() for l in args.codefile if len(l.strip()) > 0)
+    if not code:
+        log.warning("--daemon serves bytecode inputs (-c/-f); "
+                    "analyzing one-shot")
+        return False
+    modules = (
+        [m.strip() for m in args.modules.strip().split(",")]
+        if args.__dict__.get("modules") else None
+    )
+    from ..daemon.client import DaemonError, analyze_via_daemon
+
+    try:
+        # every analyzer-relevant flag travels with the request:
+        # report identity with the one-shot path holds because the
+        # daemon runs the SAME configuration, not its own defaults
+        row = analyze_via_daemon(
+            sock, code, outform=args.outform,
+            bin_runtime=bool(args.__dict__.get("bin_runtime")),
+            timeout=args.execution_timeout,
+            tpu_lanes=args.tpu_lanes,
+            transaction_count=args.transaction_count,
+            modules=modules,
+            strategy=get_analysis_strategy(args),
+            max_depth=args.max_depth,
+            call_depth_limit=args.call_depth_limit,
+            loop_bound=args.loop_bound,
+            create_timeout=args.create_timeout,
+            solver_timeout=args.solver_timeout,
+            no_onchain_data=bool(args.no_onchain_data),
+            pruning_factor=args.pruning_factor,
+            unconstrained_storage=bool(args.unconstrained_storage),
+            disable_dependency_pruning=bool(
+                args.disable_dependency_pruning),
+            transaction_sequences=args.transaction_sequences)
+    except (DaemonError, OSError) as e:
+        exit_with_error(args.outform, f"daemon analysis failed: {e}")
+        return True
+    print(row["output"])
+    # same exit-code contract as the one-shot path: 1 iff issues
+    sys.exit(1 if row.get("issue_count") else 0)
+
+
 def parse_args_and_execute(parser: argparse.ArgumentParser,
                            args: argparse.Namespace) -> None:
     if args.epic:
@@ -704,7 +805,19 @@ def parse_args_and_execute(parser: argparse.ArgumentParser,
         sys.exit(0)
 
     validate_args(args)
+    if args.command == "serve":
+        from ..daemon.server import serve
+
+        try:
+            sys.exit(serve(args.out_dir, socket_path=args.socket,
+                           workers=args.workers))
+        except KeyboardInterrupt:
+            sys.exit(0)
+        except OSError as e:
+            exit_with_error("text", f"daemon startup failed: {e}")
     try:
+        if _try_daemon_analyze(args):
+            return
         if args.command == "concolic":
             from ..concolic.concolic_execution import concolic_execution
 

@@ -1284,6 +1284,24 @@ def _storage_read_term(seed_raw: "T.Term", key: BitVec) -> BitVec:
 #: compile_code call costs host decode + five H2D transfers).
 _CC_CACHE: Dict[tuple, object] = {}
 
+#: daemon request epoch (docs/daemon.md): the resident daemon bumps
+#: this once per request, and a jit-cache hit (code plane or warmed
+#: window variant) whose compile landed in an EARLIER epoch counts as
+#: compile_reuse_hits — the cross-request amortization the daemon
+#: exists for. One-shot processes never bump it, so every hit stays
+#: same-epoch and the counter (and behavior) is bit-for-bit unchanged.
+REQUEST_EPOCH = [0]
+_CC_EPOCH: Dict[tuple, int] = {}
+_WARM_EPOCH: Dict[tuple, int] = {}
+
+
+def _note_cross_request_hit(epochs: Dict[tuple, int], key) -> None:
+    """Book a cache hit against the epoch its compile was paid in."""
+    if epochs.get(key, REQUEST_EPOCH[0]) != REQUEST_EPOCH[0]:
+        from ..smt.solver.solver_statistics import SolverStatistics
+
+        SolverStatistics().bump(compile_reuse_hits=1)
+
 #: all-DEAD SymLaneState pool keyed by shape config: a finished engine
 #: parks its device buffers here and the next engine (same shapes —
 #: possibly a different contract) adopts them instead of paying the
@@ -1323,8 +1341,13 @@ def _compiled_code(code_bytes: bytes, fentries) -> "CompiledCode":
                               det_mask=det_mask,
                               loopsum_pcs=loopsum_plane)
         if len(_CC_CACHE) >= 64:  # bound device-resident code tensors
-            _CC_CACHE.pop(next(iter(_CC_CACHE)))
+            evicted = next(iter(_CC_CACHE))
+            _CC_CACHE.pop(evicted)
+            _CC_EPOCH.pop(evicted, None)
         _CC_CACHE[key] = cc
+        _CC_EPOCH[key] = REQUEST_EPOCH[0]
+    else:
+        _note_cross_request_hit(_CC_EPOCH, key)
     return cc
 
 
@@ -1436,10 +1459,12 @@ def warm_variant(n_lanes: int, code_len: int, lane_kwargs: dict,
     with _WARM_LOCK:
         state = _WARM.get(key)
         if state == "ready":
+            _note_cross_request_hit(_WARM_EPOCH, key)
             return True
         if state == "pending":
             return False
         _WARM[key] = "pending"
+        _WARM_EPOCH[key] = REQUEST_EPOCH[0]
 
     def _compile():
         try:

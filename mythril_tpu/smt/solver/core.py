@@ -469,7 +469,31 @@ def _get_session() -> _IncrementalSession:
     return _session
 
 
-def reset_session() -> None:
+#: daemon session keep-alive (docs/daemon.md §shared-state, satellite
+#: of ISSUE 14): when True, reset_session()'s per-analysis retirement
+#: is a no-op and every worker's incremental session stays hot across
+#: requests. Sound by construction: a session's PERMANENT clauses are
+#: only Tseitin definitions and Ackermann congruence axioms —
+#: universally valid, query-independent — and each query is purely an
+#: assumption set over them, so "pop the assertion stack back to the
+#: empty frame" is the state a session already returns to between
+#: queries. Retirement is a PERF policy (a one-shot sweep over many
+#: unrelated contracts accumulates dead clauses — measured 40x over an
+#: 18-contract run); the daemon's re-submission-heavy traffic inverts
+#: that tradeoff (same code hash = same term DAG = already-blasted
+#: clauses and valid unsat cores), and the _SESSION_VAR_LIMIT recycle
+#: still bounds growth for mixed tenants.
+KEEP_SESSIONS = False
+
+
+def set_keep_sessions(keep: bool) -> None:
+    """Flip the daemon keep-alive (daemon/server.py arms it; tests
+    and MTPU_DAEMON_KEEP_SESSIONS=0 restore retirement)."""
+    global KEEP_SESSIONS
+    KEEP_SESSIONS = bool(keep)
+
+
+def reset_session(force: bool = False) -> None:
     """Drop the shared incremental session — and, via the generation
     counter, every solver-pool worker's thread-local session (each
     worker replaces its own lazily; tearing one down from here would
@@ -477,8 +501,14 @@ def reset_session() -> None:
     per contract): constraints from different contracts share no
     structure, so a stale session only adds dead clauses that every
     solve must re-satisfy (measured 40x slowdown over an 18-contract
-    sweep)."""
+    sweep).
+
+    Under the daemon keep-alive (KEEP_SESSIONS) the retirement is
+    skipped — see the flag's docstring for why that is sound — unless
+    ``force`` is set (pool reconfiguration, tests)."""
     global _session
+    if KEEP_SESSIONS and not force:
+        return
     _SESSION_GEN[0] += 1
     _session = None
 

@@ -8,20 +8,29 @@ import os
 
 
 class LockFile:
-    """Advisory exclusive lock; usable as a context manager."""
+    """Advisory exclusive lock; usable as a context manager.
+
+    ``acquire(blocking=False)`` returns False instead of waiting when
+    another process holds the lock (the warm-store GC uses this: an
+    entry mid-rewrite is hot and simply skipped this pass)."""
 
     def __init__(self, path: str):
         self.path = path
         self._fd = None
 
-    def acquire(self) -> None:
+    def acquire(self, blocking: bool = True) -> bool:
         fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        flags = fcntl.LOCK_EX | (0 if blocking else fcntl.LOCK_NB)
         try:
-            fcntl.flock(fd, fcntl.LOCK_EX)
+            fcntl.flock(fd, flags)
+        except BlockingIOError:
+            os.close(fd)
+            return False
         except OSError:
             os.close(fd)  # flock unsupported (e.g. some NFS): no fd leak
             raise
         self._fd = fd
+        return True
 
     def release(self) -> None:
         if self._fd is not None:

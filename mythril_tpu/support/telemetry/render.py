@@ -115,6 +115,14 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("static_warmed", "static_warmed"),
         ("route_wins", "route_first_try_wins"),
     )),
+    ("Daemon", "docs/daemon.md",
+     ("daemon_requests", "requests_resumed",
+      "compile_reuse_hits"), (
+        ("requests", "daemon_requests"),
+        ("queue_wait_ms", "queue_wait_ms"),
+        ("resumed", "requests_resumed"),
+        ("compile_reuse", "compile_reuse_hits"),
+    )),
     ("Checkpoint/resume", "docs/checkpoint.md",
      ("lanes_exported", "lanes_imported", "midflight_steals",
       "resume_rounds"), (

@@ -192,11 +192,32 @@ def _entry_path(key: str) -> Optional[Path]:
 
 # -- entry serialization -------------------------------------------------
 
+#: lock-file suffix beside each entry (``<key>.warm.lock``): the
+#: per-entry advisory flock serializing concurrent writers on one code
+#: hash — two daemon tenants, or a tenant racing the GC. Reads need no
+#: lock (the rename is atomic and an open fd survives an unlink), and
+#: the tmp+rename keeps even an UNLOCKED writer whole-file-atomic; the
+#: lock's job is ordering — a reader after save N sees save N, not
+#: save N-1 re-landing late — and keeping the GC from deleting an
+#: entry mid-rewrite. Lock files are empty and only GC'd once their
+#: entry is gone.
+_LOCK_SUFFIX = ".lock"
+
+
+def _entry_lock(path: Path):
+    """The per-entry advisory lock (support/lock.LockFile)."""
+    from .lock import LockFile
+
+    return LockFile(str(path) + _LOCK_SUFFIX)
+
 
 def _write_entry(key: str, payload: dict) -> bool:
     """Atomic tmp+rename write through the checkpoint term-safe
-    pickler (term DAGs travel as flat tables). Best-effort: a save
-    failure must never block the analysis it warms."""
+    pickler (term DAGs travel as flat tables), serialized per entry by
+    the advisory lock (two simultaneous requests on one code hash must
+    not interleave their saves with each other or with a GC delete).
+    Best-effort: a save failure must never block the analysis it
+    warms."""
     path = _entry_path(key)
     if path is None:
         return False
@@ -204,17 +225,19 @@ def _write_entry(key: str, payload: dict) -> bool:
         from .checkpoint import dump_with_terms
 
         path.parent.mkdir(parents=True, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".warm-")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                dump_with_terms(f, payload)
-            os.replace(tmp, path)
-        except BaseException:
+        with _entry_lock(path):
+            fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                                       prefix=".warm-")
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+                with os.fdopen(fd, "wb") as f:
+                    dump_with_terms(f, payload)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
         return True
     except Exception as e:
         log.warning("warm store save failed (%s); next run starts "
@@ -612,10 +635,45 @@ def gc_store(path=None, max_entries: Optional[int] = None,
         survivors = survivors[extra:]
     removed = []
     for f in doomed:
-        removed.append(f.name)
         if not dry_run:
+            # per-entry advisory lock, NON-blocking: a writer holding
+            # the lock is mid-save on this code hash — the entry is
+            # hot, so it survives this GC pass instead of having its
+            # fresh save deleted out from under the tenant
+            lock = _entry_lock(f)
+            try:
+                if not lock.acquire(blocking=False):
+                    survivors.append(f)
+                    continue
+            except OSError:
+                pass  # flock unsupported: fall back to plain unlink
             try:
                 f.unlink()
+            except OSError:
+                pass
+            finally:
+                try:
+                    lock.release()
+                except OSError:
+                    pass
+        removed.append(f.name)
+    if not dry_run:
+        # orphaned lock files (entry already GC'd): empty, but a
+        # long-lived store should not accrete them without bound.
+        # Skip any a live writer holds — it is about to re-create
+        # the entry.
+        for lf in d.glob("*.warm" + _LOCK_SUFFIX):
+            entry = Path(str(lf)[: -len(_LOCK_SUFFIX)])
+            if entry.exists():
+                continue
+            probe = _entry_lock(entry)
+            try:
+                if probe.acquire(blocking=False):
+                    try:
+                        lf.unlink()
+                    except OSError:
+                        pass
+                    probe.release()
             except OSError:
                 pass
     if removed and not dry_run:

@@ -378,3 +378,82 @@ def test_warm_store_high_level_api_ok(tmp_path):
             return warm_store.gc_store(path=out_dir)
     """)
     assert findings == []
+
+
+def test_socket_import_in_package_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/support/net.py", """\
+        import socket
+
+        def probe(path):
+            s = socket.socket(socket.AF_UNIX)
+            s.connect(path)
+            return s
+    """)
+    assert [f.rule for f in findings] == \
+        ["socket-io-outside-daemon"] * 3  # import + ctor + .connect
+
+
+def test_socket_bind_listen_accept_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/srv.py", """\
+        from socket import socket as mk
+
+        def serve(s, path):
+            s.bind(path)
+            s.listen(4)
+            return s.accept()
+    """)
+    rules = [f.rule for f in findings]
+    assert rules == ["socket-io-outside-daemon"] * 4
+
+
+def test_connect_without_socket_import_ok(tmp_path):
+    # sqlite3.connect / db.connect must never trip the rule — the
+    # method-name scan only arms in modules that import socket
+    findings = _lint_source(tmp_path, "mythril_tpu/support/db.py", """\
+        import sqlite3
+
+        def open_db(path):
+            conn = sqlite3.connect(path)
+            conn.bind = None
+            return conn
+    """)
+    assert findings == []
+
+
+def test_socket_in_daemon_package_exempt(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/daemon/proto.py", """\
+        import socket
+
+        def listen(path):
+            s = socket.socket(socket.AF_UNIX)
+            s.bind(path)
+            s.listen(4)
+            return s
+    """)
+    assert findings == []
+
+
+def test_socket_outside_package_ok(tmp_path):
+    findings = _lint_source(tmp_path, "tools/netcheck.py", """\
+        import socket
+
+        def up(host):
+            return socket.create_connection((host, 80))
+    """)
+    assert findings == []
+
+
+def test_socket_allowlist_suppresses(tmp_path):
+    path = tmp_path / "mythril_tpu/ops/net.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("import socket\n")
+    allow = tmp_path / "tools" / "lint_allowlist.txt"
+    allow.parent.mkdir(parents=True, exist_ok=True)
+    allow.write_text("mythril_tpu/ops/net.py:socket-io-outside-daemon\n")
+    old_repo, old_allow = lint_static.REPO, lint_static.ALLOWLIST
+    lint_static.REPO, lint_static.ALLOWLIST = tmp_path, allow
+    try:
+        findings = lint_static.lint_tree([str(path)])
+    finally:
+        lint_static.REPO, lint_static.ALLOWLIST = old_repo, old_allow
+    assert findings == []

@@ -452,3 +452,129 @@ def test_two_process_cold_then_warm_identity(tmp_path):
     assert warm_solver["verdicts_warmed"] > 0
     assert warm_solver["static_warmed"] > 0
     assert query_count(warm) < query_count(cold)
+
+
+# -- concurrent-writer hardening (ISSUE 14 satellite) --------------------
+
+
+_STRESS_WRITER = """\
+import sys, time
+sys.path.insert(0, {repo!r})
+from mythril_tpu.support import warm_store
+
+warm_store.configure({out!r})
+key, tag, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from mythril_tpu.support.checkpoint import STATIC_SIDECAR_SHAPE
+for i in range(n):
+    payload = {{
+        "version": warm_store.STORE_VERSION,
+        "code_hash": key,
+        "static_shape": STATIC_SIDECAR_SHAPE,
+        "saved_at": time.time(),
+        "verdicts": [], "static": [],
+        # a fat, writer-tagged block: torn interleavings would show
+        # as a payload mixing tags (or failing to load at all)
+        "cost": {{"fork_peak": tag, "blob": [tag] * 8000}},
+        "routing": {{}},
+    }}
+    assert warm_store._write_entry(key, payload)
+print("WROTE", tag, flush=True)
+"""
+
+
+def test_two_process_writer_stress_no_interleaving(tmp_path):
+    """Two processes hammering saves on the SAME code hash while this
+    process reads continuously: every successful read is a whole,
+    self-consistent entry from exactly one writer — never a torn mix,
+    never a validation drop (the per-entry flock orders the
+    tmp+rename saves)."""
+    import textwrap
+
+    warm_store.reset()
+    warm_store.configure(tmp_path)
+    key = "f" * 64
+    script = tmp_path / "writer.py"
+    script.write_text(_STRESS_WRITER.format(repo=str(REPO),
+                                            out=str(tmp_path)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), key, str(tag), "40"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for tag in (1, 2)
+    ]
+    reads = 0
+    try:
+        while any(p.poll() is None for p in procs):
+            time.sleep(0.005)
+            payload = warm_store._read_entry(key)
+            if payload is None:
+                continue  # not yet written
+            reads += 1
+            cost = payload["cost"]
+            tag = cost["fork_peak"]
+            assert tag in (1, 2)
+            assert cost["blob"] == [tag] * 8000, \
+                "torn write: blob does not match its tag"
+    finally:
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err[-2000:]
+            assert "WROTE" in out
+    assert reads > 0
+    # the final entry is whole and valid too
+    final = warm_store._read_entry(key)
+    assert final is not None and final["cost"]["fork_peak"] in (1, 2)
+    warm_store.reset()
+
+
+def test_gc_skips_entry_held_by_live_writer(tmp_path, store):
+    """A GC racing a writer must not delete the entry mid-rewrite:
+    the non-blocking per-entry lock probe keeps it for this pass."""
+    from mythril_tpu.support.lock import LockFile
+
+    key_a, _ = _save_entry()
+
+    class _Other(_FakeContract):
+        code = "6002600355"
+
+    key_b, _ = _save_entry(_Other())
+    path_a = Path(store) / (key_a + ".warm")
+    # make A the older entry so a max_entries=1 GC targets it
+    old = time.time() - 3600
+    os.utime(path_a, (old, old))
+    holder = LockFile(str(path_a) + warm_store._LOCK_SUFFIX)
+    assert holder.acquire(blocking=False)
+    try:
+        summary = warm_store.gc_store(max_entries=1)
+        assert key_a + ".warm" not in summary["removed"]
+        assert path_a.exists()
+    finally:
+        holder.release()
+    summary = warm_store.gc_store(max_entries=1)
+    assert key_a + ".warm" in summary["removed"]
+    assert not path_a.exists()
+
+
+def test_gc_reaps_orphaned_lock_files(tmp_path, store):
+    key, _ = _save_entry()
+    path = Path(store) / (key + ".warm")
+    lock_path = Path(str(path) + warm_store._LOCK_SUFFIX)
+    assert lock_path.exists()  # the save created it
+    # lock file of a LIVE entry survives GC
+    warm_store.gc_store(max_entries=16)
+    assert lock_path.exists()
+    path.unlink()  # entry gone, lock orphaned
+    warm_store.gc_store(max_entries=16)
+    assert not lock_path.exists()
+
+
+def test_dry_run_gc_deletes_nothing_and_takes_no_locks(store):
+    key, _ = _save_entry()
+    path = Path(store) / (key + ".warm")
+    old = time.time() - 3600
+    os.utime(path, (old, old))
+    summary = warm_store.gc_store(max_entries=0, dry_run=True)
+    assert key + ".warm" in summary["removed"]
+    assert path.exists()

@@ -98,6 +98,18 @@ use the high-level API (configure / begin_analysis / round_sink /
 end_analysis / route_for_query / gc_store) — or allowlist with a
 reason.
 
+Rule 9 — socket-io-outside-daemon (the ISSUE-14 resident-daemon
+class): importing ``socket`` (or calling the socket constructors /
+the bind-connect-listen-accept surface of a socket object) anywhere
+in ``mythril_tpu/`` outside ``mythril_tpu/daemon/``. The daemon
+package is the one sanctioned network seam — the same shape as rule
+5's raw-pickle ban and rule 8's warm-store fence: its length-framed
+protocol carries frame-size caps, stale-socket probing, and the
+master-gate contract (``MTPU_DAEMON`` off = no socket is ever
+touched), all of which an ad-hoc socket call site would silently
+skip. Engine, support, and orchestration layers talk to the daemon
+through ``daemon.client`` — or allowlist with a reason.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -228,6 +240,59 @@ def _rule8_findings(rel: str, tree) -> List["Finding"]:
         if any(isinstance(a, ast.Constant)
                and a.value == _RULE8_ENV_KEY for a in args):
             flag(node, "location resolution (MTPU_WARM_DIR)")
+    return out
+
+
+#: rule-9: the one package allowed to touch sockets (its protocol
+#: module IS the sanctioned seam), the socket-module constructors
+#: banned elsewhere, and the connection-surface method names flagged
+#: in any module that imports socket (a method name alone — e.g.
+#: sqlite3.connect — never trips the rule)
+_RULE9_EXEMPT = "mythril_tpu/daemon/"
+_SOCKET_CTORS = frozenset(
+    ("socket", "socketpair", "create_connection", "create_server",
+     "fromfd"))
+_SOCKET_METHODS = frozenset(
+    ("bind", "connect", "connect_ex", "listen", "accept"))
+
+
+def _rule9_findings(rel: str, tree) -> List["Finding"]:
+    out: List[Finding] = []
+
+    def flag(node, what):
+        out.append(Finding(
+            rel, node.lineno, "socket-io-outside-daemon",
+            "socket {} outside mythril_tpu/daemon/ — the daemon "
+            "package is the one sanctioned network seam (framed "
+            "protocol, size caps, MTPU_DAEMON master gate); go "
+            "through daemon.client or allowlist with a "
+            "reason".format(what)))
+
+    imports_socket = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _mod_parts(alias.name)[:1] == ("socket",):
+                    imports_socket = True
+                    flag(node, "import")
+        elif isinstance(node, ast.ImportFrom):
+            if _mod_parts(node.module)[:1] == ("socket",):
+                imports_socket = True
+                flag(node, "import")
+    if not imports_socket:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if (fn.attr in _SOCKET_CTORS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "socket"):
+            flag(node, "construction ({})".format(fn.attr))
+        elif fn.attr in _SOCKET_METHODS:
+            flag(node, "call (.{})".format(fn.attr))
     return out
 
 
@@ -452,6 +517,10 @@ def lint_file(path: Path) -> List[Finding]:
 
     if rel.startswith("mythril_tpu/") and rel != _RULE8_EXEMPT:
         out.extend(_rule8_findings(rel, tree))
+
+    if rel.startswith("mythril_tpu/") and \
+            not rel.startswith(_RULE9_EXEMPT):
+        out.extend(_rule9_findings(rel, tree))
 
     if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
         for node in ast.walk(tree):
