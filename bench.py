@@ -2719,6 +2719,152 @@ def _smoke_daemon():
     }
 
 
+def _smoke_pack():
+    """Stage 16: the cross-tenant wave-packing gate (docs/daemon.md
+    §wave packing).
+
+    Two `myth serve` processes fed the IDENTICAL queue of three small
+    lane-mode fixtures (plus a head request that keeps the worker busy
+    so the three actually pend together): one with MTPU_PACK=1, one
+    with MTPU_PACK=0. Gates:
+
+    * the packed daemon books waves_packed > 0 and
+      dispatches_saved > 0 (co-scheduled tenants shared windows);
+    * STRICTLY fewer fused window dispatches (lane_windows) than the
+      unpacked serving of the same queue — the avoided-work framing
+      the single-CPU wall-gate constraint demands;
+    * pack_occupancy_pct above the unpacked run (fuller waves);
+    * per-tenant issue identity: packed vs unpacked vs a fresh
+      one-shot process per fixture."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from tests.fixture_paths import INPUTS
+
+    from mythril_tpu.daemon import SOCKET_NAME
+    from mythril_tpu.daemon.client import DaemonClient, wait_ready
+
+    tmp = Path(tempfile.mkdtemp(prefix="mtpu_pack_smoke_"))
+    repo = Path(__file__).resolve().parent
+    LANES, TIMEOUT = 16, 120
+    names = ("suicide.sol.o", "returnvalue.sol.o", "origin.sol.o")
+    fixtures = {n: (INPUTS / n).read_text().strip() for n in names}
+    warm_hex = (INPUTS / "safe_funcs.sol.o").read_text().strip()
+
+    def _env(pack_on):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MTPU_PACK"] = "1" if pack_on else "0"
+        env.pop("XLA_FLAGS", None)
+        env.pop("MTPU_WARM_DIR", None)
+        return env
+
+    def _run_queue(out_dir, pack_on):
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "mythril_tpu", "serve",
+             "--out-dir", str(out_dir)],
+            cwd=str(repo), env=_env(pack_on), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        sock = str(out_dir / SOCKET_NAME)
+        try:
+            if not wait_ready(sock, 180):
+                raise RuntimeError("daemon never became ready")
+            kw = dict(bin_runtime=True, timeout=TIMEOUT,
+                      tpu_lanes=LANES)
+            warm = threading.Thread(target=lambda: DaemonClient(
+                sock).analyze(warm_hex, name="warm", id="warm", **kw))
+            warm.start()
+            time.sleep(0.8)
+            rows = {}
+
+            def submit(name):
+                rows[name] = DaemonClient(sock).analyze(
+                    fixtures[name], name=name,
+                    id=name.replace(".", "_"), **kw)
+
+            subs = [threading.Thread(target=submit, args=(n,))
+                    for n in names]
+            for s in subs:
+                s.start()
+            for s in subs:
+                s.join(timeout=420)
+            warm.join(timeout=420)
+            counters = DaemonClient(sock).ping()["counters"]
+            DaemonClient(sock).shutdown()
+            daemon.communicate(timeout=60)
+            return rows, counters
+        finally:
+            if daemon.poll() is None:
+                daemon.kill()
+
+    def _oneshot(name, code_hex):
+        fixture = tmp / name
+        fixture.write_text(code_hex)
+        out_dir = tmp / ("oneshot_" + name)
+        proc = subprocess.run(
+            [sys.executable, "-m", "mythril_tpu.parallel.corpus",
+             "--out-dir", str(out_dir), "--timeout", str(TIMEOUT),
+             "--tpu-lanes", str(LANES), str(fixture)],
+            cwd=str(repo), env=_env(True), capture_output=True,
+            text=True, timeout=420)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"one-shot run failed:\n{proc.stderr[-2000:]}")
+        report = json.loads(
+            (out_dir / "corpus_report.json").read_text())
+        return report["contracts"][0]
+
+    def _canon(row):
+        return sorted({i["swc-id"] for i in row["issues"]})
+
+    t0 = time.perf_counter()
+    try:
+        rows_on, c_on = _run_queue(tmp / "on", True)
+        rows_off, c_off = _run_queue(tmp / "off", False)
+        oneshots = {n: _oneshot(n, fixtures[n]) for n in names}
+    except Exception as e:
+        shutil.rmtree(tmp, ignore_errors=True)
+        return {"error": type(e).__name__, "detail": str(e)[:500],
+                "ok": False}
+    wall = round(time.perf_counter() - t0, 1)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    identity = all(
+        _canon(rows_on[n]) == _canon(rows_off[n])
+        == oneshots[n].get("swc")
+        and rows_on[n]["issue_count"] == rows_off[n]["issue_count"]
+        == oneshots[n].get("issues")
+        for n in names)
+    gates = {
+        "waves_packed": c_on.get("waves_packed", 0) > 0,
+        "dispatches_saved": c_on.get("dispatches_saved", 0) > 0,
+        "fewer_dispatches_than_unpacked":
+            c_on.get("lane_windows", 0)
+            < c_off.get("lane_windows", 0),
+        "unpacked_really_off": c_off.get("waves_packed", 0) == 0,
+        "occupancy_above_unpacked":
+            c_on.get("pack_occupancy_pct", 0)
+            > c_off.get("pack_occupancy_pct", 0),
+        "per_tenant_issue_identity": identity,
+    }
+    return {
+        "wall_s": wall,
+        "windows_packed": c_on.get("lane_windows", 0),
+        "windows_unpacked": c_off.get("lane_windows", 0),
+        "waves_packed": c_on.get("waves_packed", 0),
+        "pack_members": c_on.get("pack_members", 0),
+        "dispatches_saved": c_on.get("dispatches_saved", 0),
+        "occupancy_on_pct": c_on.get("pack_occupancy_pct", 0),
+        "occupancy_off_pct": c_off.get("pack_occupancy_pct", 0),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
 def bench_smoke():
     """`bench.py --smoke`: CI-fast visibility run
     for the drain pipeline, the batched feasibility discharge, and the
@@ -2836,6 +2982,16 @@ def bench_smoke():
        request 2, issue identity daemon-vs-one-shot on every request,
        and a SIGTERM mid-request leaving a resumable persisted queue.
        Any miss exits 1; skippable via MTPU_SMOKE_DAEMON=0.
+
+    16. the wave-packing gate (_smoke_pack, docs/daemon.md §wave
+       packing): the identical three-small-fixture lane queue served
+       by a MTPU_PACK=1 daemon and a MTPU_PACK=0 daemon — the packed
+       run gates waves_packed > 0, dispatches_saved > 0, STRICTLY
+       fewer fused window dispatches than the unpacked serving,
+       pack_occupancy_pct above the unpacked run, and per-tenant
+       issue identity packed vs unpacked vs a fresh one-shot process
+       per fixture. Any miss exits 1; skippable via
+       MTPU_SMOKE_PACK=0.
 
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
@@ -3113,6 +3269,20 @@ def bench_smoke():
     else:
         out["daemon"] = {"skipped": True, "ok": True}
 
+    # stage 16: the wave-packing gate (docs/daemon.md §wave packing):
+    # the same three-fixture lane queue served packed vs MTPU_PACK=0 —
+    # waves_packed > 0, strictly fewer window dispatches, occupancy
+    # above the unpacked run, per-tenant issue identity vs one-shot;
+    # skippable via MTPU_SMOKE_PACK=0
+    if os.environ.get("MTPU_SMOKE_PACK", "1") != "0":
+        try:
+            out["pack"] = _smoke_pack()
+        except Exception as e:
+            out["pack"] = {"ok": False, "error": type(e).__name__,
+                           "detail": str(e)[:200]}
+    else:
+        out["pack"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -3176,7 +3346,12 @@ def bench_smoke():
           # than request 1 and a fresh one-shot), shares the warm
           # store across tenants, reports identically to the one-shot
           # path, and SIGTERM-drains into a resumable queue
-          and out["daemon"].get("ok", False))
+          and out["daemon"].get("ok", False)
+          # the wave-packing gate: co-scheduled tenants provably
+          # shared device waves (packed waves, saved dispatches,
+          # strictly fewer windows, higher occupancy) with per-tenant
+          # issue identity packed vs unpacked vs one-shot
+          and out["pack"].get("ok", False))
     return 0 if ok else 1
 
 

@@ -162,7 +162,10 @@ class AnalysisDaemon:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._pending: List[Request] = []
-        self._active: Dict[int, Request] = {}  # worker idx -> request
+        #: worker idx -> the request(s) it is serving (one normally;
+        #: several when a packed wave co-schedules a batch —
+        #: docs/daemon.md §wave packing)
+        self._active: Dict[int, List[Request]] = {}
         self._stop = threading.Event()
         self._drain = True
         self._listener = None
@@ -281,7 +284,8 @@ class AnalysisDaemon:
         """Atomically write the resumable queue snapshot."""
         with self._lock:
             pending = [r.to_dict() for r in self._pending]
-            interrupted = [r.to_dict() for r in self._active.values()] \
+            interrupted = [r.to_dict() for reqs in
+                           self._active.values() for r in reqs] \
                 if include_active else []
         payload = {"version": QUEUE_VERSION, "pending": pending,
                    "interrupted": interrupted}
@@ -388,7 +392,8 @@ class AnalysisDaemon:
                     self._safe_send(conn, {
                         "event": "pong", "pid": os.getpid(),
                         "queued": len(self._pending),
-                        "active": len(self._active),
+                        "active": sum(len(reqs) for reqs in
+                                      self._active.values()),
                         "completed": self._completed,
                         "counters": {
                             "daemon_requests": ss.daemon_requests,
@@ -397,6 +402,13 @@ class AnalysisDaemon:
                             "requests_resumed": ss.requests_resumed,
                             "compile_reuse_hits":
                                 ss.compile_reuse_hits,
+                            "waves_packed": ss.waves_packed,
+                            "pack_members": ss.pack_members,
+                            "pack_occupancy_pct": round(
+                                ss.pack_occupancy_pct, 1),
+                            "dispatches_saved": ss.dispatches_saved,
+                            "lane_windows": ss.lane_windows,
+                            "mat_pool_reuses": ss.mat_pool_reuses,
                         }})
             elif op == "result":
                 self._op_result(conn, msg)
@@ -453,7 +465,8 @@ class AnalysisDaemon:
                 pass
         with self._lock:
             live = any(r.id == rid for r in self._pending) or any(
-                r.id == rid for r in self._active.values())
+                r.id == rid for reqs in self._active.values()
+                for r in reqs)
         self._safe_send(conn, {"event": "pending" if live
                                else "unknown", "id": rid})
 
@@ -467,7 +480,8 @@ class AnalysisDaemon:
                             "splittable": r.splittable,
                             "resumed": r.resumed}
                            for r in self._pending],
-                "active": [r.id for r in self._active.values()],
+                "active": [r.id for reqs in self._active.values()
+                           for r in reqs],
                 "completed": self._completed,
                 "workers": self.workers})
 
@@ -550,9 +564,17 @@ class AnalysisDaemon:
                 if not self._pending:
                     continue
                 req = self._pop_scheduled()
-                self._active[idx] = req
+                # cross-tenant wave packing (docs/daemon.md §wave
+                # packing): co-schedulable small requests ride the
+                # same device waves as one PackGroup
+                peers = self._pop_pack_peers(req)
+                batch = [req] + peers
+                self._active[idx] = batch
             try:
-                self._run_request(req)
+                if peers:
+                    self._run_packed(batch)
+                else:
+                    self._run_request(req)
             except (KeyboardInterrupt, MemoryError):
                 raise
             except Exception:
@@ -564,8 +586,100 @@ class AnalysisDaemon:
             finally:
                 with self._cond:
                     self._active.pop(idx, None)
-                    self._completed += 1
+                    self._completed += len(batch)
                     self._cond.notify_all()
+
+    # -- cross-tenant wave packing (docs/daemon.md §wave packing) ----------
+
+    @staticmethod
+    def _pack_shape(req: Request) -> tuple:
+        """The admission key: every analyzer-relevant knob EXCEPT the
+        code itself (and the cost-model name). Two requests with equal
+        shapes run identical round structures — same strategy, tx
+        count, timeouts, module set, lane width — which is what lets
+        their waves fold without per-member divergence in engine
+        config."""
+        p = req.params
+        return tuple(
+            (k, json.dumps(p.get(k), sort_keys=True, default=str))
+            for k in sorted(REQUEST_DEFAULTS)
+            if k not in ("code", "name"))
+
+    @staticmethod
+    def _pack_width_clamp() -> int:
+        """Combined-width admission bound: the capacity autoprobe's
+        persisted clamp when one was ever recorded (docs/
+        drain_pipeline.md), else 0 = unbounded (pick_width still
+        right-sizes the packed wave)."""
+        try:
+            from ..parallel import cost_model
+
+            return int(cost_model.WIDTH_CLAMP or 0)
+        except Exception:
+            return 0
+
+    def _pop_pack_peers(self, head: Request) -> List[Request]:
+        """Pull pending requests co-schedulable with ``head`` (callers
+        hold the lock): MTPU_PACK on, lane mode, identical pack shape,
+        combined lane width under the autoprobe clamp, at most
+        MTPU_PACK_MAX members. Resumed requests stay solo — their
+        checkpoint-resume path wants the exact solo seams it dumped
+        under. With fewer than 2 compatible requests admitted the
+        one-request-per-wave path is untouched by construction."""
+        from ..laser import wave_pack
+
+        if not wave_pack.enabled() or not self._pending:
+            return []
+        if int(head.params.get("tpu_lanes") or 0) <= 0 \
+                or head.resumed:
+            return []
+        shape = self._pack_shape(head)
+        clamp = self._pack_width_clamp()
+        total = int(head.params["tpu_lanes"])
+        cap = wave_pack.pack_max()
+        peers: List[Request] = []
+        for r in list(self._pending):
+            if len(peers) + 1 >= cap:
+                break
+            if r.resumed or self._pack_shape(r) != shape:
+                continue
+            width = int(r.params["tpu_lanes"])
+            if clamp and total + width > clamp:
+                continue
+            total += width
+            peers.append(r)
+        for r in peers:
+            self._pending.remove(r)
+        return peers
+
+    def _run_packed(self, reqs: List[Request]) -> None:
+        """Serve a co-scheduled batch as one PackGroup: each member
+        runs the full `_run_request` path on its own member thread
+        (strictly baton-serialized), their waves fold into packed
+        explores, and per-request counters come from the group's
+        snapshot/diff attribution instead of the solo c0/c1 diff."""
+        from ..laser import wave_pack
+        from ..smt.solver import core
+
+        log.info("wave packing: co-scheduling %d requests (%s)",
+                 len(reqs), ", ".join(r.id for r in reqs))
+        if self.keep_sessions:
+            # interleaved member codes share no constraint structure;
+            # a session kept across the pack boundary would drag dead
+            # clauses (the 11x pathology) — start fresh and re-key
+            # the code affinity after the pack
+            core.reset_session(force=True)
+        self._last_code_hash = None
+        group = wave_pack.PackGroup()
+        for req in reqs:
+            group.add_member(
+                req.id, lambda r=req: self._run_request(r, pack=group))
+        members = group.run()
+        for req in reqs:
+            m = members.get(req.id)
+            if m is not None and m.error is not None:
+                log.error("packed request %s leaked an error: %s",
+                          req.id, m.error)
 
     def _retire_sessions_on_code_change(self, req: Request) -> None:
         """Session keep-alive is CODE-AFFINE: sessions stay hot across
@@ -596,23 +710,28 @@ class AnalysisDaemon:
             except Exception:  # pragma: no cover - accounting only
                 pass
 
-    def _run_request(self, req: Request) -> None:
+    def _run_request(self, req: Request, pack=None) -> None:
         from ..smt.solver.solver_statistics import SolverStatistics
         from ..support.telemetry import trace
 
         ss = SolverStatistics()
         wait_ms = max(0.0, _now_ms() - req.enqueued_ms)
         self._bump_compile_epoch()
-        self._retire_sessions_on_code_change(req)
+        if pack is None:
+            self._retire_sessions_on_code_change(req)
         self._safe_send(req.conn, {"event": "started", "id": req.id,
                                    "resumed": req.resumed})
         t0 = time.perf_counter()
+        # packed members: the solo c0/c1 diff would bleed every
+        # co-scheduled member's work into this row — the group's
+        # baton-boundary snapshot/diff attribution replaces it
         c0 = {k: v for k, v in ss.batch_counters().items()
-              if isinstance(v, (int, float))}
+              if isinstance(v, (int, float))} if pack is None else None
         ss.bump(daemon_requests=1, queue_wait_ms=wait_ms)
         try:
             with trace.span("daemon.request", id=req.id,
-                            resumed=req.resumed):
+                            resumed=req.resumed,
+                            packed=pack is not None):
                 row = self._analyze(req)
         except (KeyboardInterrupt, MemoryError):
             raise
@@ -625,15 +744,20 @@ class AnalysisDaemon:
                 req.conn.close()
             return
         wall = time.perf_counter() - t0
-        c1 = ss.batch_counters()
         row["event"] = "report"
         row["id"] = req.id
         row["resumed"] = req.resumed
         row["wall_s"] = round(wall, 3)
         row["queue_wait_ms"] = round(wait_ms, 1)
-        row["counters"] = {
-            k: round(c1[k] - v, 1) for k, v in c0.items()
-            if isinstance(c1.get(k), (int, float))}
+        if pack is None:
+            c1 = ss.batch_counters()
+            row["counters"] = {
+                k: round(c1[k] - v, 1) for k, v in c0.items()
+                if isinstance(c1.get(k), (int, float))}
+        else:
+            row["counters"] = pack.counters_for(req.id)
+            row["packed"] = True
+            row["counters_shared"] = dict(pack.shared_counters)
         self._persist_done_row(req, row)
         self._record_cost(req, wall)
         self._safe_send(req.conn, row)

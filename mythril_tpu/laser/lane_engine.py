@@ -90,11 +90,20 @@ class LaneCtx:
 
     __slots__ = ("template", "conds", "addr2idx", "storage_seed_raw",
                  "calldata", "gas0_min", "gas0_max", "promos",
-                 "swrites")
+                 "swrites", "owner", "code_base", "func_names")
 
     def __init__(self, template, addr2idx, storage_seed_raw, calldata,
-                 gas0_min, gas0_max):
+                 gas0_min, gas0_max, owner=None, code_base=0,
+                 func_names=None):
         self.template = template
+        #: cross-tenant wave packing (docs/daemon.md §wave packing):
+        #: the owning request's tag (None outside packed explores —
+        #: READ only through retire_ring.owner_of, lint rule 10), the
+        #: member segment's arena base offset, and the member's own
+        #: function-name map (None = use the engine's per-explore map)
+        self.owner = owner
+        self.code_base = code_base
+        self.func_names = func_names
         # [(global step, Bool)] — the step stamp lets drain-time sites
         # reconstruct the constraint prefix at any earlier record
         self.conds: List[tuple] = []
@@ -112,7 +121,9 @@ class LaneCtx:
 
     def clone(self) -> "LaneCtx":
         c = LaneCtx(self.template, self.addr2idx, self.storage_seed_raw,
-                    self.calldata, self.gas0_min, self.gas0_max)
+                    self.calldata, self.gas0_min, self.gas0_max,
+                    owner=self.owner, code_base=self.code_base,
+                    func_names=self.func_names)
         c.conds = list(self.conds)
         c.promos = {k: list(v) for k, v in self.promos.items()}
         c.swrites = list(self.swrites)
@@ -155,14 +166,18 @@ class _DrainSite:
             gs.world_state.constraints.append(c)
         ms = gs.mstate
         a2i = self.ctx.addr2idx
-        ms.pc = int(a2i[min(self.byte_pc, a2i.shape[0] - 1)])
+        # device pcs are arena coordinates under a packed wave; the
+        # ctx carries its member segment's base (0 unpacked)
+        byte_pc = self.byte_pc - self.ctx.code_base
+        ms.pc = int(a2i[min(max(byte_pc, 0), a2i.shape[0] - 1)])
         if self.gmin is not None:
             ms.min_gas_used = self.ctx.gas0_min + int(self.gmin)
             ms.max_gas_used = self.ctx.gas0_max + int(self.gmax)
         fentry = self.fentry
-        if fentry >= 0 and fentry in self.engine._func_names:
-            gs.environment.active_function_name = \
-                self.engine._func_names[fentry]
+        fnames = self.ctx.func_names if self.ctx.func_names \
+            is not None else self.engine._func_names
+        if fentry >= 0 and fentry in fnames:
+            gs.environment.active_function_name = fnames[fentry]
         for v in self.stack_tail:
             ms.stack.append(v)
         return gs
@@ -1351,6 +1366,73 @@ def _compiled_code(code_bytes: bytes, fentries) -> "CompiledCode":
     return cc
 
 
+# -- cross-tenant wave packing (docs/daemon.md §wave packing) ---------------
+
+
+class _PackMember:
+    """One member of a packed explore: the owner tag (request id), its
+    code bytes, arena base, and function-name map. The verified
+    loop-summary park planes pack per member (the owning svm applies
+    the closed forms — solo behavior); the det-mask plane ships empty
+    and the host static retire / jump patching stand down under
+    packing (documented in PARITY.md) — issue identity is gated by
+    those layers' own on/off equivalence."""
+
+    __slots__ = ("owner", "code", "base", "func_names")
+
+    def __init__(self, owner, code, base, func_names):
+        self.owner = owner
+        self.code = code
+        self.base = base
+        self.func_names = func_names
+
+
+#: packed CompiledCode per member-key tuple (code bytes + sorted
+#: function entries per member). Bounded like _CC_CACHE; the arena /
+#: segment-count pow2 bucketing makes the underlying jit variants
+#: repeat across distinct packs of the same shape.
+_PACK_CC_CACHE: Dict[tuple, tuple] = {}
+_PACK_CC_EPOCH: Dict[tuple, int] = {}
+
+
+def _compiled_packed(member_keys: tuple):
+    """(CompiledCode, bases) for a tuple of (code_bytes, fentries,
+    loopsum_heads) member keys — the head set is part of the cache key
+    for the same reason _compiled_code's is (gate flips mid-process
+    must not adopt a stale park plane)."""
+    key = tuple(member_keys)
+    hit = _PACK_CC_CACHE.get(key)
+    if hit is None:
+        from ..analysis import static_pass
+        from ..analysis.static_pass import loop_summary
+        from ..ops.stepper import compile_packed_code
+
+        spec = []
+        for code, fentries, heads in key:
+            plane = None
+            if heads:
+                try:
+                    plane = loop_summary.device_park_pcs(
+                        static_pass.info_for(code))
+                except Exception:
+                    plane = None
+            spec.append((code, fentries, plane))
+        with _prof("compile_code"), trace.span(
+                "xla.compile_code",
+                code_len=sum(len(c) for c, _f, _h in key),
+                members=len(key)):
+            cc, bases = compile_packed_code(spec)
+        if len(_PACK_CC_CACHE) >= 32:
+            evicted = next(iter(_PACK_CC_CACHE))
+            _PACK_CC_CACHE.pop(evicted)
+            _PACK_CC_EPOCH.pop(evicted, None)
+        hit = _PACK_CC_CACHE[key] = (cc, bases)
+        _PACK_CC_EPOCH[key] = REQUEST_EPOCH[0]
+    else:
+        _note_cross_request_hit(_PACK_CC_EPOCH, key)
+    return hit
+
+
 # -- background jit warmup ---------------------------------------------------
 #
 # The fused window dispatch takes ~7-20 s to XLA-compile through a
@@ -1734,6 +1816,9 @@ class LaneEngine:
         for name in blocked_ops or ():
             if name in _OPB:
                 table[_OPB[name]] = False
+        #: the hook-blocked opcode set, kept for the wave-pack
+        #: coordinator to replicate this config on a packed engine
+        self.blocked_ops = frozenset(blocked_ops or ())
         self.exec_table = jnp.asarray(table)
         self.adapters = list(adapters or ())
         taint = np.zeros(256, bool)
@@ -1822,6 +1907,13 @@ class LaneEngine:
         #: live lane ctxs of an explore in progress (SIGTERM dump
         #: path: support/checkpoint.snapshot_live_states)
         self._explore_ctxs = None
+        #: packed-wave issue attribution (docs/daemon.md §wave
+        #: packing): owner tag -> context manager activating that
+        #: request's RunContext, so drain-time site firing lands
+        #: issues in the OWNING member's detector lists. None (the
+        #: default, incl. every plain explore) fires sites under the
+        #: caller's context — bit-for-bit today's behavior.
+        self.owner_context = None
         #: per-boundary _merge_fingerprint cache (None = not computed
         #: this boundary, False = kernel failed) shared by the window
         #: merge and the merge-before-spill pass — ONE dispatch serves
@@ -1877,8 +1969,11 @@ class LaneEngine:
         out["BASEFEE"] = entry(env.basefee)
         return out
 
-    def _seed_spec(self, gs: GlobalState, calldata_cap: int):
-        """(LaneCtx, host-side per-lane values) for one entry state."""
+    def _seed_spec(self, gs: GlobalState, calldata_cap: int,
+                   member=None):
+        """(LaneCtx, host-side per-lane values) for one entry state.
+        ``member`` is the packed-wave member record (owner tag, arena
+        base, function-name map) or None for a plain explore."""
         env = gs.environment
         acct = env.active_account
         ms = gs.mstate
@@ -1913,8 +2008,14 @@ class LaneEngine:
         dev_limit = max(int(ms.gas_limit) - int(gas0_min), 0) \
             if isinstance(ms.gas_limit, int) else 0xFFFFFFF
 
-        ctx = LaneCtx(gs, addr2idx, storage_raw, calldata,
-                      gas0_min, gas0_max)
+        if member is None:
+            ctx = LaneCtx(gs, addr2idx, storage_raw, calldata,
+                          gas0_min, gas0_max)
+        else:
+            ctx = LaneCtx(gs, addr2idx, storage_raw, calldata,
+                          gas0_min, gas0_max, owner=member.owner,
+                          code_base=member.base,
+                          func_names=member.func_names)
 
         envw = self._env_words(gs)
         if self.adapters:
@@ -1973,6 +2074,8 @@ class LaneEngine:
         byte_pc = 0
         if ms.pc:
             byte_pc = ilist[ms.pc]["address"]
+        if member is not None:
+            byte_pc += member.base  # seed in arena coordinates
         stack_v = np.zeros((n_depth, bv256.NLIMBS), np.uint32)
         stack_s = np.zeros(n_depth, np.int32)
         for i, item in enumerate(ms.stack):
@@ -2018,8 +2121,8 @@ class LaneEngine:
         n_env = symstep.N_ENV
         lanes, specs = [], []
         with _prof("seed_pack"):
-            for lane, gs in entries:
-                ctx, spec = self._seed_spec(gs, calldata_cap)
+            for lane, gs, member in entries:
+                ctx, spec = self._seed_spec(gs, calldata_cap, member)
                 ctxs[lane] = ctx
                 lanes.append(lane)
                 specs.append(spec)
@@ -2234,6 +2337,16 @@ class LaneEngine:
         if key in self._fired_sites:
             return
         self._fired_sites.add(key)
+        if self.owner_context is not None:
+            # packed wave: site-firing modules append to the global
+            # detector singletons, so fire under the lane OWNER's
+            # RunContext (per-request issue attribution)
+            from .retire_ring import owner_of
+
+            with self.owner_context(owner_of(ctx)):
+                for ad in self.adapters:
+                    ad.on_jumpi_site(cond, site)
+            return
         for ad in self.adapters:
             ad.on_jumpi_site(cond, site)
 
@@ -2545,16 +2658,21 @@ class LaneEngine:
         for _, cond in ctx.conds:
             gs.world_state.constraints.append(cond)
 
-        byte_pc = int(st_host["pc"][lane])
-        ms.pc = int(ctx.addr2idx[min(byte_pc,
+        # device pcs are arena coordinates under a packed wave (the
+        # ctx carries its member segment's base, 0 unpacked); fentry
+        # values are member-local by construction (symstep records the
+        # pushed destination, not the arena pc)
+        byte_pc = int(st_host["pc"][lane]) - ctx.code_base
+        ms.pc = int(ctx.addr2idx[min(max(byte_pc, 0),
                                      ctx.addr2idx.shape[0] - 1)])
         ms.depth += int(st_host["depth"][lane])
         # active function from the last function-entry jump the device
         # took (svm._new_node_state parity for host-executed jumps)
         fentry = int(st_host["fentry"][lane])
-        if fentry >= 0 and fentry in self._func_names:
-            gs.environment.active_function_name = \
-                self._func_names[fentry]
+        fnames = ctx.func_names if ctx.func_names is not None \
+            else self._func_names
+        if fentry >= 0 and fentry in fnames:
+            gs.environment.active_function_name = fnames[fentry]
         ms.min_gas_used = ctx.gas0_min + int(st_host["min_gas"][lane])
         ms.max_gas_used = ctx.gas0_max + int(st_host["max_gas"][lane])
 
@@ -2690,6 +2808,8 @@ class LaneEngine:
         if self.adapters:
             last_jump = int(st_host["last_jump"][lane]) \
                 if "last_jump" in st_host else -1
+            if last_jump >= 0:
+                last_jump -= ctx.code_base  # arena -> member-local
             for ad in self.adapters:
                 plist = ctx.promos.get(id(ad), ())
                 ad.attach(gs, [a for (_, a) in plist], last_jump)
@@ -3070,9 +3190,18 @@ class LaneEngine:
         gas_widen = merge_mod.gas_widen_enabled()
         merged = subsumed = widened = 0
         dropped_lanes: List[int] = []
+        from .retire_ring import owner_of as _owner_of
+
         for _key, lanes in pre.items():
             if len(lanes) < 2:
                 continue
+            # cross-tenant lanes must never OR-merge (docs/daemon.md
+            # §wave packing): the pre-group keys on id(template) and
+            # arena pc, both per-member by construction, so a mixed
+            # group is a routing bug — assert rather than merge wrong
+            assert len({_owner_of(ctxs[lane])
+                        for lane in lanes}) == 1, \
+                "cross-tenant lanes reached one merge group"
             twins: Dict[tuple, List[int]] = {}
             for lane in lanes:
                 tkey = (int(fp[lane, 0]), int(fp[lane, 1]))
@@ -3349,25 +3478,97 @@ class LaneEngine:
         """Run entry states on device until every path parks or dies;
         returns the materialized parked states (each positioned at the
         first instruction the device could not execute)."""
+        return self._explore_members(
+            ((code_bytes, entry_states, None),))[None]
 
-        self._func_names = dict(
-            getattr(entry_states[0].environment.code,
-                    "address_to_function_name", {}) or {}
-        ) if entry_states else {}
+    def explore_packed(self, members) -> Dict[object, list]:
+        """Cross-tenant packed explore (docs/daemon.md §wave packing):
+        ``members`` is [(code_bytes, entry_states, owner)] with
+        distinct owner tags; every member's lanes ride the SAME window
+        dispatches over one segment-arena CompiledCode, and retires
+        route back per tenant (retire_ring.TenantRouter) in submit
+        order. Returns {owner: parked states}. Member execution is
+        independent by construction — per-seed group ids key the
+        device record dedup, arena pcs are disjoint across segments,
+        and the merge pre-groups key on per-member templates — so
+        per-tenant results are identical to running each member's
+        explore alone (gated by tests/test_wave_pack.py)."""
+        owners = [owner for _c, _s, owner in members]
+        assert len(set(owners)) == len(owners), \
+            "packed members need distinct owner tags"
+        assert self.mesh is None, "packed waves do not shard (yet)"
+        return self._explore_members(tuple(members))
+
+    def _explore_members(self, members) -> Dict[object, list]:
+        packed = len(members) > 1
+        code_bytes = members[0][0]
+        mems: List[Optional[_PackMember]] = []
         stats0 = dict(self.stats)  # engines persist across explores
         self._reset_explore_memos()
-        # static pre-analysis (docs/static_pass.md): memoized per code
-        # hash; feeds the window-boundary retire, the jump-table
-        # consult on symbolic JUMP parks, and the det-mask plane the
-        # compile below ships with the code tensors
-        try:
-            from ..analysis import static_pass
+        if not packed:
+            entry_states = members[0][1]
+            self._func_names = dict(
+                getattr(entry_states[0].environment.code,
+                        "address_to_function_name", {}) or {}
+            ) if entry_states else {}
+            # static pre-analysis (docs/static_pass.md): memoized per
+            # code hash; feeds the window-boundary retire, the
+            # jump-table consult on symbolic JUMP parks, and the
+            # det-mask plane the compile below ships with the code
+            # tensors
+            try:
+                from ..analysis import static_pass
 
-            self._static_info = static_pass.info_for(code_bytes)
-        except Exception as e:  # a screen, never an error path
-            log.debug("static pass unavailable: %s", e)
+                self._static_info = static_pass.info_for(code_bytes)
+            except Exception as e:  # a screen, never an error path
+                log.debug("static pass unavailable: %s", e)
+                self._static_info = None
+            cc = _compiled_code(code_bytes, self._func_names.keys())
+            mems.append(None)
+        else:
+            # packed wave: per-member function maps ride the lane
+            # ctxs. The verified loop-summary park planes pack per
+            # member (lanes park at summarizable heads and the OWNING
+            # svm applies the closed form after the sweep, exactly the
+            # solo path — without this, packed waves UNROLL the loops
+            # PR 12 closed, measured a 75 s regression on a
+            # metacoin+underflow pack). The remaining per-code host
+            # consumers (static retire, jump patching) stand down —
+            # their gates' own on/off identity covers the parity.
+            self._func_names = {}
             self._static_info = None
-        cc = _compiled_code(code_bytes, self._func_names.keys())
+            member_keys = []
+            for code, states, owner in members:
+                fnames = dict(
+                    getattr(states[0].environment.code,
+                            "address_to_function_name", {}) or {}
+                ) if states else {}
+                heads = ()
+                try:
+                    from ..analysis import static_pass
+                    from ..analysis.static_pass import loop_summary
+
+                    if static_pass.enabled() \
+                            and loop_summary.enabled():
+                        info = static_pass.info_for(code)
+                        if info is not None:
+                            heads = tuple(sorted(
+                                loop_summary.summarizable_heads(
+                                    info)))
+                except Exception as e:
+                    log.debug("packed loop-summary heads "
+                              "unavailable: %s", e)
+                member_keys.append(
+                    (code, tuple(sorted(fnames.keys())), heads))
+                mems.append(_PackMember(owner, code, 0, fnames))
+            cc, bases = _compiled_packed(tuple(member_keys))
+            for m, base in zip(mems, bases):
+                m.base = base
+            from ..smt.solver.solver_statistics import (
+                SolverStatistics as _SSP,
+            )
+
+            _SSP().bump(waves_packed=1, pack_members=len(members))
         if self._rep_sh is not None:
             # SPMD mode: code tensors (and the op tables) replicate
             # across the mesh so the sharded dispatch sees consistent
@@ -3389,17 +3590,34 @@ class LaneEngine:
         # windows AND explores of the same code (the interpreter's
         # execute_state coverage hook cannot see device steps; this is
         # its device twin — svm merges it into the coverage plugin)
-        visited = self._visited_dev.pop(code_bytes, None)
+        visited = self._visited_dev.pop(code_bytes, None) \
+            if not packed else None
         if visited is None:
             visited = jnp.zeros(cc.packed.shape[0], bool)
+        #: arena length drives the window-variant compile keys (the
+        #: pow2 code buckets make packed and plain variants share)
+        code_len = len(code_bytes) if not packed \
+            else int(cc.packed.shape[0]) - 1
         st = self._acquire_state()
         ctxs: List[Optional[LaneCtx]] = [None] * self.n_lanes
         # expose the live ctx table for the SIGTERM live dump
         # (live_seed_states); cleared in the finally below
         self._explore_ctxs = ctxs
-        queue = deque(entry_states)
+        queue = deque((midx, gs) for midx, (_c, states, _o)
+                      in enumerate(members) for gs in states)
+        n_entries = len(queue)
         free = list(range(self.n_lanes - 1, -1, -1))
         results: List[GlobalState] = []
+        from .retire_ring import TenantRouter, owner_of
+
+        if packed:
+            router = TenantRouter([m.owner for m in mems])
+            sink = router
+            deliver = router.deliver
+        else:
+            router = None
+            sink = results
+            deliver = lambda _owner, gs: results.append(gs)  # noqa: E731
         calldata_cap = int(st.calldata.shape[1])
         n = self.n_lanes
 
@@ -3428,7 +3646,7 @@ class LaneEngine:
         # provisional-sid map — the next drain REPLACES self._prov.
         from .retire_ring import RetireRing
 
-        ring = RetireRing(workers=mat_workers(), sink=results)
+        ring = RetireRing(workers=mat_workers(), sink=sink)
         self._ring = ring
         from ..smt.solver.solver_statistics import SolverStatistics \
             as _SS
@@ -3458,9 +3676,19 @@ class LaneEngine:
             def build(rows_host):
                 t0 = time.perf_counter()
                 with trace.span("retire.materialize", n=len(items)):
-                    out = [self.materialize(rows_host, row, ctx,
-                                            prov=prov)
-                           for row, ctx in items]
+                    if packed:
+                        # retire chunks carry the owner tag: the ring
+                        # sink (TenantRouter) routes each state into
+                        # its request's worklist in submit order
+                        out = [
+                            (owner_of(ctx),
+                             self.materialize(rows_host, row, ctx,
+                                              prov=prov))
+                            for row, ctx in items]
+                    else:
+                        out = [self.materialize(rows_host, row, ctx,
+                                                prov=prov)
+                               for row, ctx in items]
                 with self._stats_lock:
                     self.stats["overlap_mat"] += len(items)
                     self.stats["overlap_mat_ms"] += int(
@@ -3489,8 +3717,8 @@ class LaneEngine:
         screen_dead: List[int] = []
         t_idle0 = None
         trace.begin("lane.explore", n_lanes=self.n_lanes,
-                    entries=len(entry_states),
-                    code_len=len(code_bytes))
+                    entries=n_entries, code_len=code_len,
+                    pack_members=len(members) if packed else 0)
         try:
             while True:
                 # per-boundary fingerprint cache: the window merge and
@@ -3504,20 +3732,22 @@ class LaneEngine:
                 full_bucket = self._full_bucket()
                 if (len(queue) > small or len(resumes) > small) \
                         and full_bucket > small and warm_variant(
-                    self.n_lanes, len(code_bytes), self.lane_kwargs,
+                    self.n_lanes, code_len, self.lane_kwargs,
                     self.window, self.step_budget,
                     seed_bucket=full_bucket,
                 ):
                     seed_cap = full_bucket
                 entries = []
                 while queue and free and len(entries) < seed_cap:
-                    gs = queue.popleft()
+                    midx, gs = queue.popleft()
                     if self.adapters and not all(
                         ad.seed_ok(gs) for ad in self.adapters
                     ):
-                        results.append(gs)  # host handles this entry
+                        # host handles this entry
+                        deliver(mems[midx].owner if packed else None,
+                                gs)
                         continue
-                    entries.append((free.pop(), gs))
+                    entries.append((free.pop(), gs, mems[midx]))
                 i32buf, u8buf, k, pv = self._pack_window(
                     entries, ctxs, free, kill, calldata_cap,
                     big=seed_cap > small, resumes=resumes)
@@ -3578,6 +3808,24 @@ class LaneEngine:
                         (round(time.perf_counter() - _tw, 3), k,
                          len(code_bytes), self.n_lanes))
                 self.stats["windows"] += 1
+                # device-dispatch accounting (docs/daemon.md §wave
+                # packing): window count feeds the bench "strictly
+                # fewer dispatches" gate; occupancy is the live-lane
+                # share of the wave — packed waves carry several
+                # tenants' lanes through the same dispatches
+                _solver_stats.bump(lane_windows=1)
+                live_now = n - len(free)
+                if live_now > 0:
+                    _solver_stats.bump_max(pack_occupancy_pct=round(
+                        100.0 * live_now / n, 1))
+                if packed:
+                    from .retire_ring import owner_of as _oof
+
+                    owners_live = {_oof(c) for c in ctxs
+                                   if c is not None}
+                    if len(owners_live) > 1:
+                        _solver_stats.bump(
+                            dispatches_saved=len(owners_live) - 1)
                 t_wait0 = time.perf_counter()
                 with _prof("window_pull"), \
                         trace.span("lane.window_pull"):
@@ -3674,7 +3922,7 @@ class LaneEngine:
                 full_r = self._full_bucket()
                 if len(held) > small and full_r > small \
                         and warm_variant(
-                    self.n_lanes, len(code_bytes),
+                    self.n_lanes, code_len,
                     self.lane_kwargs, self.window,
                     self.step_budget, seed_bucket=full_r,
                 ):
@@ -3728,8 +3976,10 @@ class LaneEngine:
                             self.stats["device_steps"] += \
                                 int(steps[lane])
                             if lane not in dead_set:
-                                results.append(self.materialize(
-                                    rows_host, row, ctxs[lane]))
+                                deliver(owner_of(ctxs[lane]),
+                                        self.materialize(
+                                            rows_host, row,
+                                            ctxs[lane]))
                             ctxs[lane] = None
                             free.append(lane)
                     status[np.asarray(lanes_sel, np.int32)] = DEAD
@@ -3931,24 +4181,42 @@ class LaneEngine:
             # a donated-then-failed dispatch can leave the bitmap
             # deleted, in which case drop it rather than crash
             try:
-                self._visited_dev[code_bytes] = visited
-                self.visited_by_code[code_bytes] = np.asarray(
-                    jax.device_get(visited))[: cc.size]
+                if not packed:
+                    self._visited_dev[code_bytes] = visited
+                    self.visited_by_code[code_bytes] = np.asarray(
+                        jax.device_get(visited))[: cc.size]
+                else:
+                    # per-member coverage: slice each segment out of
+                    # the arena bitmap and OR into the per-code map
+                    vh = np.asarray(jax.device_get(visited))
+                    for m in mems:
+                        cur = vh[m.base: m.base + len(m.code)]
+                        prev = self.visited_by_code.get(m.code)
+                        if prev is not None \
+                                and prev.shape == cur.shape:
+                            cur = cur | prev
+                        self.visited_by_code[m.code] = cur
             except Exception:
-                self._visited_dev.pop(code_bytes, None)
+                if not packed:
+                    self._visited_dev.pop(code_bytes, None)
         self._release_state(st)
         # static jump-table consult (docs/static_pass.md): a symbolic-
         # dest JUMP park with a statically-proved singleton target
         # continues in place instead of dying in the interpreter
-        results = self._patch_jump_parks(results)
+        # (per-code — stands down under a packed wave)
+        if not packed:
+            results = self._patch_jump_parks(results)
         global LAST_RUN_STATS
         delta = {k: v - stats0.get(k, 0) for k, v in self.stats.items()}
-        if peak_demand > PATH_HISTORY.get(code_bytes, 0):
+        if not packed \
+                and peak_demand > PATH_HISTORY.get(code_bytes, 0):
             PATH_HISTORY[code_bytes] = peak_demand
         LAST_RUN_STATS = self.last_run_stats = delta
         for key, val in delta.items():
             RUN_STATS_TOTAL[key] = RUN_STATS_TOTAL.get(key, 0) + val
-        return results
+        if packed:
+            return router.lists
+        return {None: results}
 
     # -- device-state pooling ------------------------------------------------
 

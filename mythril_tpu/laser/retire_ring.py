@@ -55,6 +55,82 @@ log = logging.getLogger(__name__)
 DEFAULT_CAPACITY = 16
 
 
+def owner_of(ctx):
+    """The owner tag riding a lane ctx (cross-tenant wave packing,
+    docs/daemon.md §wave packing) — None outside packed explores.
+
+    This is the ONE sanctioned read of the per-lane owner tag (lint
+    rule 10 `owner-tag-read-outside-ring`): routing decisions must
+    flow through the ring's delivery seam, so a tenant's states can
+    never be consumed under another tenant's identity by an ad-hoc
+    attribute peek."""
+    return getattr(ctx, "owner", None)
+
+
+class TenantRouter:
+    """Per-tenant delivery sink for packed waves: one worklist per
+    owner tag, appended in ring-delivery order — which the ring pins
+    to submit order regardless of worker count — so each tenant's
+    worklist is IDENTICAL to the one its solo explore would build.
+    Quacks like the plain ``results`` list for the ring's
+    ``sink.extend`` contract, but takes (owner, state) pairs."""
+
+    def __init__(self, owners):
+        self.lists = {owner: [] for owner in owners}
+
+    def deliver(self, owner, state) -> None:
+        self.lists[owner].append(state)
+
+    def append(self, pair) -> None:
+        owner, state = pair
+        self.lists[owner].append(state)
+
+    def extend(self, pairs) -> None:
+        for owner, state in pairs:
+            self.lists[owner].append(state)
+
+
+# -- persistent materialization worker pool (ROADMAP item 3b) ---------------
+#
+# K>=2 rings used to spawn their own worker threads per explore; at
+# daemon scale that is thousands of short-lived threads per corpus.
+# The pool below is process-wide: the first K>=2 ring spawns the
+# workers, every later ring reuses them (`mat_pool_reuses`), and jobs
+# from concurrent rings interleave safely — delivery order is pinned
+# per ring by its own seq-ordered pending deque, not by completion
+# order. K=1 stays zero-thread by construction.
+
+_POOL_CV = threading.Condition()
+_POOL_QUEUE: deque = deque()
+_POOL_THREADS: List[threading.Thread] = []
+
+
+def _pool_worker() -> None:
+    while True:
+        with _POOL_CV:
+            while not _POOL_QUEUE:
+                _POOL_CV.wait()
+            job = _POOL_QUEUE.popleft()
+        job.run()
+
+
+def _ensure_pool(workers: int) -> bool:
+    """Grow the shared pool to at least ``workers`` threads; True when
+    the pool already satisfied the request (a reuse)."""
+    with _POOL_CV:
+        need = workers - len(_POOL_THREADS)
+        if need <= 0:
+            return True
+        for i in range(need):
+            t = threading.Thread(
+                target=_pool_worker,
+                name=f"retire-mat-{len(_POOL_THREADS)}",
+                daemon=True)
+            t.start()
+            _POOL_THREADS.append(t)
+        return False
+
+
 def ring_capacity() -> int:
     """MTPU_RETIRE_RING (chunks held before backpressure); min 1."""
     try:
@@ -100,10 +176,6 @@ class RetireRing:
         self._pending: deque = deque()  # jobs awaiting delivery
         self._seq = 0
         self.high_water = 0
-        self._threads: List[threading.Thread] = []
-        self._queue: deque = deque()    # jobs awaiting a worker (K>=2)
-        self._cv = threading.Condition()
-        self._shutdown = False
         if self.workers > 1:
             # worker materialization interns terms concurrently with
             # the engine thread's drain: flip the interning miss path
@@ -111,24 +183,14 @@ class RetireRing:
             from ..smt import terms as T
 
             T.set_thread_safe_interning(True)
-            for i in range(self.workers):
-                t = threading.Thread(target=self._worker,
-                                     name=f"retire-mat-{i}",
-                                     daemon=True)
-                t.start()
-                self._threads.append(t)
+            # persistent pool (ROADMAP item 3b): threads spawn once
+            # per process and amortize across explores AND requests
+            if _ensure_pool(self.workers):
+                from ..smt.solver.solver_statistics import (
+                    SolverStatistics,
+                )
 
-    # -- worker side (K>=2 only) --------------------------------------------
-
-    def _worker(self) -> None:
-        while True:
-            with self._cv:
-                while not self._queue and not self._shutdown:
-                    self._cv.wait()
-                if self._shutdown and not self._queue:
-                    return
-                job = self._queue.popleft()
-            job.run()
+                SolverStatistics().bump(mat_pool_reuses=1)
 
     # -- engine side ---------------------------------------------------------
 
@@ -143,9 +205,9 @@ class RetireRing:
         self._pending.append(job)
         self.high_water = max(self.high_water, len(self._pending))
         if self.workers > 1:
-            with self._cv:
-                self._queue.append(job)
-                self._cv.notify()
+            with _POOL_CV:
+                _POOL_QUEUE.append(job)
+                _POOL_CV.notify()
 
     def _deliver_one(self) -> None:
         job = self._pending.popleft()
@@ -178,9 +240,10 @@ class RetireRing:
         return out
 
     def close(self) -> None:
-        """Stop the worker threads (pending jobs are NOT delivered —
-        call flush first)."""
-        if self.workers > 1:
-            with self._cv:
-                self._shutdown = True
-                self._cv.notify_all()
+        """Detach from the shared worker pool (pending jobs are NOT
+        delivered — call flush first). The pool threads themselves are
+        process-wide and persist for the next explore/request
+        (ROADMAP item 3b); undelivered queued jobs from this ring
+        still run harmlessly (their results are simply dropped with
+        the ring)."""
+        self._pending.clear()

@@ -855,7 +855,19 @@ class LaserEVM:
                             bus_mig.lane_export_client()
                     except Exception:
                         engine.export_client = None
-                parked = engine.explore(code, states)
+                # cross-tenant wave packing (laser/wave_pack.py): a
+                # pack-member analysis routes its wave through the
+                # group coordinator — co-scheduled members' lanes fold
+                # into ONE packed dispatch, solo waves run this very
+                # engine unchanged. None outside pack-member threads.
+                from .wave_pack import current_client
+
+                _pack_client = current_client()
+                if _pack_client is not None:
+                    parked = _pack_client.explore(self, engine, code,
+                                                  states)
+                else:
+                    parked = engine.explore(code, states)
             except Exception as e:  # any failure falls back to host
                 log.warning(
                     "lane engine failed (%s); continuing host-side", e)
@@ -904,6 +916,12 @@ class LaserEVM:
                 log.debug("loop-summary sweep application failed: %s",
                           e)
             run = engine.last_run_stats
+            if run is None:
+                # packed wave: the dispatch ran on the group's shared
+                # engine, not this member's — its device counters live
+                # in the SolverStatistics shared bucket (wave_pack)
+                run = {"device_steps": 0, "forks": 0, "records": 0,
+                       "windows": 0}
             if slim_stop:
                 # transaction-end shortcut: lane-retired states parked
                 # at a top-level STOP skip the worklist round trip —

@@ -30,7 +30,7 @@ the lane for the host. Gas is static-cost accounting (the host engine owns
 the exact interval gas required by VMTests assertions).
 """
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -199,6 +199,13 @@ class CompiledCode(NamedTuple):
 
     packed: jnp.ndarray  # (L+1, 14) int32, see column layout below
     size: int  # real code length (static)
+    #: cross-tenant wave packing (compile_packed_code): per-arena-PC
+    #: member index and the (S, 2) [base, size] segment table, both
+    #: None for a plain single-contract compile — the pytree structure
+    #: then differs, so the unpacked jit variants (and their persistent
+    #: XLA cache entries) are untouched by construction
+    seg_of: Optional[jnp.ndarray] = None   # (L+1,) int32
+    seg_tab: Optional[jnp.ndarray] = None  # (S, 2) int32
 
     @property
     def opcode(self):  # (L+1,) int32, padded with STOP
@@ -260,6 +267,65 @@ def _code_bucket(length: int) -> int:
     return length
 
 
+def _fill_code_planes(planes: dict, code: bytes, base: int,
+                      func_entries=(), det_mask=None,
+                      loopsum_pcs=None) -> None:
+    """Decode one contract's bytecode into the per-pc plane arrays at
+    arena offset ``base`` (``base=0`` for a plain compile): opcode,
+    next_pc (in ARENA coordinates), jumpdest/func-entry masks, PUSH
+    immediates, and the optional static-pass / loop-summary columns."""
+    length = len(code)
+    opcode, next_pc = planes["opcode"], planes["next_pc"]
+    for addr in func_entries:
+        if 0 <= addr <= length:
+            planes["is_func_entry"][base + addr] = True
+    i = 0
+    while i < length:
+        op = code[i]
+        opcode[base + i] = op
+        if 0x60 <= op <= 0x7F:
+            n = op - 0x5F
+            arg = code[i + 1 : i + 1 + n]
+            planes["push_value"][base + i] = bv256.int_to_limbs(
+                int.from_bytes(arg, "big"))
+            next_pc[base + i] = base + i + 1 + n
+        elif op == _OP["JUMPDEST"]:
+            planes["is_jumpdest"][base + i] = True
+        i = next_pc[base + i] - base
+    if det_mask is not None:
+        n = min(len(det_mask), length + 1)
+        planes["mask_col"][base:base + n] = np.asarray(
+            det_mask[:n], dtype=np.uint32)
+    if loopsum_pcs is not None:
+        n = min(len(loopsum_pcs), length + 1)
+        planes["loopsum_col"][base:base + n] = np.asarray(
+            loopsum_pcs[:n], dtype=bool)
+
+
+def _alloc_code_planes(padded: int) -> dict:
+    return {
+        "opcode": np.full(padded + 1, _OP["STOP"], dtype=np.int32),
+        "push_value": np.zeros((padded + 1, bv256.NLIMBS),
+                               dtype=np.uint32),
+        "next_pc": np.arange(1, padded + 2, dtype=np.int32),
+        "is_jumpdest": np.zeros(padded + 1, dtype=bool),
+        "is_func_entry": np.zeros(padded + 1, dtype=bool),
+        "mask_col": np.zeros(padded + 1, dtype=np.uint32),
+        "loopsum_col": np.zeros(padded + 1, dtype=np.int32),
+    }
+
+
+def _pack_planes(planes: dict) -> np.ndarray:
+    return np.concatenate([
+        planes["opcode"][:, None], planes["next_pc"][:, None],
+        planes["is_jumpdest"][:, None].astype(np.int32),
+        planes["is_func_entry"][:, None].astype(np.int32),
+        planes["push_value"].view(np.int32),
+        planes["mask_col"][:, None].view(np.int32),
+        planes["loopsum_col"][:, None],
+    ], axis=1)
+
+
 def compile_code(code: bytes, func_entries=(),
                  det_mask=None, loopsum_pcs=None) -> CompiledCode:
     """func_entries: byte addresses of function entry points (the
@@ -272,46 +338,67 @@ def compile_code(code: bytes, func_entries=(),
     loop-summary heads (loop_summary.device_park_pcs) — lanes park
     there instead of unrolling; zeros when the layer is off."""
     length = len(code)
-    padded = _code_bucket(length)
-    opcode = np.full(padded + 1, _OP["STOP"], dtype=np.int32)
-    push_value = np.zeros((padded + 1, bv256.NLIMBS), dtype=np.uint32)
-    next_pc = np.arange(1, padded + 2, dtype=np.int32)
-    is_jumpdest = np.zeros(padded + 1, dtype=bool)
-    is_func_entry = np.zeros(padded + 1, dtype=bool)
-    for addr in func_entries:
-        if 0 <= addr <= length:
-            is_func_entry[addr] = True
+    planes = _alloc_code_planes(_code_bucket(length))
+    _fill_code_planes(planes, code, 0, func_entries, det_mask,
+                      loopsum_pcs)
+    return CompiledCode(packed=jnp.asarray(_pack_planes(planes)),
+                        size=length)
 
-    i = 0
-    while i < length:
-        op = code[i]
-        opcode[i] = op
-        if 0x60 <= op <= 0x7F:
-            n = op - 0x5F
-            arg = code[i + 1 : i + 1 + n]
-            push_value[i] = bv256.int_to_limbs(int.from_bytes(arg, "big"))
-            next_pc[i] = i + 1 + n
-        elif op == _OP["JUMPDEST"]:
-            is_jumpdest[i] = True
-        i = next_pc[i]
 
-    mask_col = np.zeros(padded + 1, dtype=np.uint32)
-    if det_mask is not None:
-        n = min(len(det_mask), padded + 1)
-        mask_col[:n] = np.asarray(det_mask[:n], dtype=np.uint32)
-    loopsum_col = np.zeros(padded + 1, dtype=np.int32)
-    if loopsum_pcs is not None:
-        n = min(len(loopsum_pcs), padded + 1)
-        loopsum_col[:n] = np.asarray(loopsum_pcs[:n], dtype=bool)
-    packed = np.concatenate([
-        opcode[:, None], next_pc[:, None],
-        is_jumpdest[:, None].astype(np.int32),
-        is_func_entry[:, None].astype(np.int32),
-        push_value.view(np.int32),
-        mask_col[:, None].view(np.int32),
-        loopsum_col[:, None],
-    ], axis=1)
-    return CompiledCode(packed=jnp.asarray(packed), size=length)
+# -- cross-tenant wave packing (docs/daemon.md §wave packing) ---------------
+
+#: STOP-filled guard bytes between packed segments: a lane walking off
+#: its member's code end must halt inside its own region before ever
+#: reading a neighbour's plane rows (the longest pc advance is a
+#: PUSH32's 33 bytes; jumps are bounded by the member's own size)
+SEG_GUARD = 64
+
+
+def _seg_bucket(n: int) -> int:
+    """pow2 segment-count bucket, so seg_tab shapes (and with them the
+    packed jit variants' compile keys) repeat across packs."""
+    return 1 << max(1, (max(1, n) - 1).bit_length())
+
+
+def compile_packed_code(members) -> "tuple[CompiledCode, list]":
+    """One segment-arena CompiledCode for several member contracts
+    (cross-tenant wave packing): each member's plane tables land at a
+    STOP-guarded base offset, next_pc is compiled in arena coordinates,
+    and two extra tensors — ``seg_of`` (arena pc -> member index) and
+    ``seg_tab`` ((S, 2) [base, size] rows, S pow2-bucketed) — let
+    symstep resolve each lane's jump bounds, CODESIZE, and PC values
+    against its OWN member through one indirect load. The arena length
+    pads to the shared _code_bucket sizes, so packed compile keys
+    repeat across packs of the same bucket pair.
+
+    ``members``: [(code_bytes, func_entries)] or
+    [(code_bytes, func_entries, loopsum_pcs)] — the optional
+    per-member verified loop-summary park plane
+    (loop_summary.device_park_pcs) packs at the member's base like
+    every other per-PC plane, so summarizable loops park for the host
+    closed form inside packed waves exactly as they do solo. Returns
+    (CompiledCode, [base offsets])."""
+    assert members, "packed compile needs at least one member"
+    bases, off = [], 0
+    for member in members:
+        bases.append(off)
+        off += len(member[0]) + SEG_GUARD
+    padded = _code_bucket(off)
+    planes = _alloc_code_planes(padded)
+    seg_of = np.zeros(padded + 1, dtype=np.int32)
+    seg_tab = np.zeros((_seg_bucket(len(members)), 2), dtype=np.int32)
+    for idx, (member, base) in enumerate(zip(members, bases)):
+        code, fentries = member[0], member[1]
+        loopsum_pcs = member[2] if len(member) > 2 else None
+        _fill_code_planes(planes, code, base, fentries,
+                          loopsum_pcs=loopsum_pcs)
+        end = bases[idx + 1] if idx + 1 < len(bases) else padded + 1
+        seg_of[base:end] = idx
+        seg_tab[idx] = (base, len(code))
+    return CompiledCode(packed=jnp.asarray(_pack_planes(planes)),
+                        size=off,
+                        seg_of=jnp.asarray(seg_of),
+                        seg_tab=jnp.asarray(seg_tab)), bases
 
 
 # ---------------------------------------------------------------------------

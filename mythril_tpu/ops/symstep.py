@@ -450,6 +450,21 @@ def sym_step(code: CompiledCode, st: SymLaneState,
 
     running = st.status == Status.RUNNING
     pc_c = jnp.clip(st.pc, 0, code.size)
+    if code.seg_tab is not None:
+        # cross-tenant packed arena (stepper.compile_packed_code):
+        # lane pcs are ARENA coordinates, so the owning member segment
+        # is a per-pc lookup; jump bounds, CODESIZE and the PC opcode
+        # resolve against the member's own [base, size] row through
+        # this one indirect load. Plain compiles take the other branch
+        # at trace time — their jit variants (and cached XLA
+        # executables) are untouched.
+        _seg = code.seg_of[jnp.clip(pc_c, 0,
+                                    code.seg_of.shape[0] - 1)]
+        _srow = code.seg_tab[jnp.clip(_seg, 0,
+                                      code.seg_tab.shape[0] - 1)]
+        seg_base, seg_size = _srow[:, 0], _srow[:, 1]
+    else:
+        seg_base, seg_size = None, None
     op = code.opcode[pc_c]
     # idle lanes execute JUMPDEST (a supported no-op) to stay masked out
     op = jnp.where(running, op, _OP["JUMPDEST"]).astype(jnp.int32)
@@ -524,10 +539,21 @@ def sym_step(code: CompiledCode, st: SymLaneState,
                        new_msize.astype(jnp.uint32))
 
     # ---- jump destination decode ------------------------------------------
+    # `dest` stays in MEMBER-LOCAL coordinates (it is what the program
+    # pushed — recorded in fork logs and fentry tracking for host
+    # parity); `dest_eff` is the arena pc control flow actually takes
     dest_u32, dest_hi = _u32_of(a)
-    dest_small = ~dest_hi & (dest_u32 < jnp.uint32(code.size))
-    dest = jnp.where(dest_small, dest_u32, 0).astype(jnp.int32)
-    dest_ok = dest_small & code.is_jumpdest[jnp.clip(dest, 0, code.size)]
+    if seg_base is None:
+        dest_small = ~dest_hi & (dest_u32 < jnp.uint32(code.size))
+        dest = jnp.where(dest_small, dest_u32, 0).astype(jnp.int32)
+        dest_eff = dest
+    else:
+        dest_small = ~dest_hi & (dest_u32
+                                 < seg_size.astype(jnp.uint32))
+        dest = jnp.where(dest_small, dest_u32, 0).astype(jnp.int32)
+        dest_eff = jnp.where(dest_small, dest + seg_base, 0)
+    dest_ok = dest_small & code.is_jumpdest[
+        jnp.clip(dest_eff, 0, code.size)]
     jumpi_taken_conc = ~sym_b & ~bv256.is_zero(b)
 
     # ---- EXP purity: device defers only 0/1/2^m concrete bases ------------
@@ -1054,7 +1080,9 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     env_idx = jnp.asarray(ENV_TABLE)[op]
     env_r = _onehot_gather(st.env, jnp.clip(env_idx, 0, N_ENV - 1))
     env_sid_r = _gather_flat(st.env_sid, jnp.clip(env_idx, 0, N_ENV - 1))
-    pc_r = bv256.from_u32(st.pc.astype(jnp.uint32))
+    pc_r = bv256.from_u32(st.pc.astype(jnp.uint32)) \
+        if seg_base is None \
+        else bv256.from_u32((st.pc - seg_base).astype(jnp.uint32))
     # GAS pushes mstate.gas_limit (host parity: gas_ in
     # laser/instructions.py) — the same value the GASLIMIT env slot is
     # seeded with, NOT the device's oog budget (which is reduced by the
@@ -1062,7 +1090,9 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     gl_slot = ENV_SLOTS["GASLIMIT"]
     gas_r = st.env[:, gl_slot, :]
     cds_r = bv256.from_u32(st.cd_size.astype(jnp.uint32))
-    codesize_r = bv256.from_u32(jnp.full((n,), code.size, jnp.uint32))
+    codesize_r = bv256.from_u32(
+        jnp.full((n,), code.size, jnp.uint32)) if seg_base is None \
+        else bv256.from_u32(seg_size.astype(jnp.uint32))
     push_r = code.push_value[pc_c]
     dup_r = _peek(st.stack, st.sp, dup_n)
     dup_sid = _peek_sid(st.ssid, st.sp, dup_n)
@@ -1166,11 +1196,12 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     # ---- control flow -----------------------------------------------------
     next_pc = code.next_pc[pc_c]
     new_pc = next_pc
-    new_pc = jnp.where(is_jump, dest, new_pc)
-    new_pc = jnp.where(is_jumpi & ~sym_b & jumpi_taken_conc, dest, new_pc)
+    new_pc = jnp.where(is_jump, dest_eff, new_pc)
+    new_pc = jnp.where(is_jumpi & ~sym_b & jumpi_taken_conc, dest_eff,
+                       new_pc)
     # symbolic JUMPI: parent takes the jump; the forked child (below)
     # takes the fall-through
-    new_pc = jnp.where(fork_can, dest, new_pc)
+    new_pc = jnp.where(fork_can, dest_eff, new_pc)
 
     new_depth = st.depth + (ok & is_jumpi).astype(jnp.int32)
 
@@ -1180,7 +1211,7 @@ def sym_step(code: CompiledCode, st: SymLaneState,
     jumped = ok & (
         is_jump | (is_jumpi & ~sym_b & jumpi_taken_conc) | fork_can
     )
-    dest_c2 = jnp.clip(dest, 0, code.size)
+    dest_c2 = jnp.clip(dest_eff, 0, code.size)
     new_fentry = jnp.where(
         jumped & code.is_func_entry[dest_c2], dest, st.fentry
     )

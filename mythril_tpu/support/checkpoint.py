@@ -507,6 +507,12 @@ def snapshot_live_states(laser) -> list:
     if current is not None:
         states.append(current)
     states.extend(getattr(laser, "_pi_wave", ()) or ())
+    # states this analysis handed to an in-flight packed wave
+    # (laser/wave_pack.py): they left the worklist but have not been
+    # delivered back — re-enter them so a SIGTERM mid-packed-wave
+    # dump stays a complete per-request payload (their device progress
+    # re-executes on resume, like any live seed below)
+    states.extend(getattr(laser, "_pack_pending_states", ()) or ())
     engines = getattr(laser, "_lane_engines", None) or {}
     for engine in list(engines.values()):
         try:

@@ -123,6 +123,15 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("resumed", "requests_resumed"),
         ("compile_reuse", "compile_reuse_hits"),
     )),
+    ("Wave packing", "docs/daemon.md",
+     ("waves_packed", "dispatches_saved", "mat_pool_reuses"), (
+        ("waves", "waves_packed"),
+        ("members", "pack_members"),
+        ("occupancy_pct", "pack_occupancy_pct"),
+        ("dispatches_saved", "dispatches_saved"),
+        ("windows", "lane_windows"),
+        ("mat_pool_reuses", "mat_pool_reuses"),
+    )),
     ("Checkpoint/resume", "docs/checkpoint.md",
      ("lanes_exported", "lanes_imported", "midflight_steals",
       "resume_rounds"), (

@@ -157,6 +157,20 @@ def configure(out_dir) -> None:
         _ACTIVE = active()
 
 
+def swap_analysis(state: Optional[dict]) -> Optional[dict]:
+    """Exchange the begin_analysis/end_analysis bracket — the packed
+    daemon's member baton switch (docs/daemon.md §wave packing): each
+    member's in-flight analysis context (code hash key, verdict-bank
+    mark, static key set) parks with the member, so interleaved
+    tenants keep per-request bank attribution. Returns the outgoing
+    bracket (None when no analysis was in flight)."""
+    global _CURRENT
+    with _LOCK:
+        prev = _CURRENT
+        _CURRENT = state
+    return prev
+
+
 def reset() -> None:
     """Drop all in-process store state (tests)."""
     global _CONFIGURED_DIR, _CURRENT, _ACTIVE

@@ -25,6 +25,13 @@ enable_compile_cache()
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` budget run "
+        "(multi-process daemon lifecycles and similar long tails)")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_execution_deadline():
     """Clear the global execution deadline around every test.

@@ -457,3 +457,42 @@ def test_socket_allowlist_suppresses(tmp_path):
     finally:
         lint_static.REPO, lint_static.ALLOWLIST = old_repo, old_allow
     assert findings == []
+
+
+# -- rule 10: owner-tag-read-outside-ring (ISSUE-15 wave packing) ----------
+
+
+def test_owner_read_in_laser_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/peek.py", """\
+        def route(ctx, sinks):
+            sinks[ctx.owner].append(ctx)
+    """)
+    assert [f.rule for f in findings] == ["owner-tag-read-outside-ring"]
+
+
+def test_owner_write_in_laser_ok(tmp_path):
+    # stamping the tag is fine — only READS route decisions
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/stamp.py", """\
+        def stamp(ctx, owner):
+            ctx.owner = owner
+    """)
+    assert findings == []
+
+
+def test_owner_read_in_ring_exempt(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/laser/retire_ring.py", """\
+        def owner_of(ctx):
+            return ctx.owner
+    """)
+    assert findings == []
+
+
+def test_owner_read_outside_laser_ok(tmp_path):
+    # the rule fences the lane layer; daemon-side request owners are
+    # per-request admission objects, not per-lane tags
+    findings = _lint_source(tmp_path, "mythril_tpu/daemon/adm.py", """\
+        def key(req):
+            return req.owner
+    """)
+    assert findings == []

@@ -1,0 +1,548 @@
+"""Cross-tenant wave packing (docs/daemon.md §wave packing).
+
+Covers the four coupled tentpole pieces and the satellites:
+
+* the packed CompiledCode segment arena (stepper.compile_packed_code);
+* engine-level packed-vs-solo identity per tenant, incl. the
+  within-tenant-only merge guarantee (cross-tenant lanes must never
+  OR-merge — their arena pcs and templates make mixed groups
+  impossible, and `_collapse_twins` asserts it);
+* per-tenant retire routing order (retire_ring.TenantRouter) under
+  K=1 and K=2 materialization workers;
+* the persistent materialization worker pool (K=1 spawns zero
+  threads; later K>=2 rings reuse the process pool);
+* PackGroup baton interleaving: per-member issue identity with
+  sequential runs, and the counter no-bleed regression (stats
+  snapshot/diff keyed by request at pack boundaries);
+* the daemon admission policy end to end: a queue of small lane
+  requests served packed vs MTPU_PACK=0 vs the one-shot path —
+  identical per-tenant issues, waves_packed>0, strictly fewer window
+  dispatches;
+* SIGTERM mid-pack -> restart -> every member resumes independently
+  (slow-marked; the in-process suite stays inside the tier-1 budget).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from mythril_tpu.daemon.client import (
+    DaemonClient,
+    DaemonError,
+    wait_ready,
+)
+from mythril_tpu.daemon.server import AnalysisDaemon, Request
+from mythril_tpu.laser import lane_engine, retire_ring, wave_pack
+from mythril_tpu.laser.retire_ring import RetireRing, TenantRouter
+from mythril_tpu.ops import stepper
+from mythril_tpu.orchestration.mythril_analyzer import MythrilAnalyzer
+from mythril_tpu.orchestration.mythril_disassembler import (
+    MythrilDisassembler,
+)
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support.analysis_args import make_cmd_args
+from mythril_tpu.support.support_args import args as global_args
+
+from .test_stream_retire import (
+    _diamond_code,
+    _fork_tree_code,
+    _reset_modules,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# packed CompiledCode arena
+# ---------------------------------------------------------------------------
+
+
+class TestPackedCompile:
+    A = bytes([0x60, 0x04, 0x56, 0x00, 0x5B, 0x00])   # PUSH1 4 JUMP
+    B = bytes([0x60, 0x01, 0x60, 0x02, 0x01, 0x00])   # 1+2 STOP
+
+    def test_arena_layout(self):
+        cc, bases = stepper.compile_packed_code(
+            [(self.A, ()), (self.B, (2,))])
+        assert bases[0] == 0
+        assert bases[1] == len(self.A) + stepper.SEG_GUARD
+        packed = np.asarray(cc.packed)
+        # member opcodes land at their bases; the guard gap is STOP
+        assert packed[0, 0] == 0x60 and packed[2, 0] == 0x56
+        assert packed[bases[1], 0] == 0x60
+        assert (packed[len(self.A):bases[1], 0] == 0x00).all()
+        # jumpdest plane: only A's JUMPDEST at arena offset 4
+        assert np.nonzero(packed[:, 2])[0].tolist() == [4]
+        # func entry of member B at arena base+2
+        assert np.nonzero(packed[:, 3])[0].tolist() == [bases[1] + 2]
+        # next_pc is arena-coordinate (PUSH1 at base skips its arg)
+        assert packed[bases[1], 1] == bases[1] + 2
+
+    def test_seg_tables_pow2_bucketed(self):
+        cc, bases = stepper.compile_packed_code(
+            [(self.A, ()), (self.B, ()), (self.A, ())])
+        tab = np.asarray(cc.seg_tab)
+        assert tab.shape[0] == 4  # 3 members -> pow2 bucket
+        assert tab[0].tolist() == [0, len(self.A)]
+        assert tab[2].tolist() == [bases[2], len(self.A)]
+        seg = np.asarray(cc.seg_of)
+        for i, base in enumerate(bases):
+            assert seg[base] == i
+            assert seg[base + len(self.A) - 1] == i
+        # plain compiles stay seg-free (the unpacked jit variants and
+        # their cached XLA executables are untouched by construction)
+        plain = stepper.compile_code(self.A)
+        assert plain.seg_of is None and plain.seg_tab is None
+
+    def test_arena_length_buckets_shared(self):
+        cc1, _ = stepper.compile_packed_code([(self.A, ()),
+                                              (self.B, ())])
+        cc2, _ = stepper.compile_packed_code([(self.B, ()),
+                                              (self.A * 3, ())])
+        # same arena bucket + same seg bucket = same tensor shapes =
+        # one shared jit variant across distinct packs
+        assert cc1.packed.shape == cc2.packed.shape
+        assert cc1.seg_tab.shape == cc2.seg_tab.shape
+
+
+# ---------------------------------------------------------------------------
+# engine-level packed identity
+# ---------------------------------------------------------------------------
+
+
+def _capture_entries(code, tx_count=1):
+    """(entry states, )—the real tx-entry states a lane analysis of
+    `code` seeds, captured at the first sweep."""
+    captured = {}
+    orig = lane_engine.LaneEngine.explore
+
+    def spy(self, cb, states):
+        captured.setdefault("states", list(states))
+        return orig(self, cb, states)
+
+    lane_engine.LaneEngine.explore = spy
+    try:
+        _reset_modules()
+        dis = MythrilDisassembler(eth=None)
+        address, _ = dis.load_from_bytecode(code.hex(),
+                                            bin_runtime=True)
+        analyzer = MythrilAnalyzer(
+            disassembler=dis,
+            cmd_args=make_cmd_args(execution_timeout=120,
+                                   tpu_lanes=64),
+            strategy="bfs", address=address)
+        lane_engine.PATH_HISTORY[code] = 64
+        analyzer.fire_lasers(modules=None,
+                             transaction_count=tx_count)
+    finally:
+        lane_engine.LaneEngine.explore = orig
+        global_args.tpu_lanes = 64
+    return captured["states"]
+
+
+def _state_sig(gs):
+    return (gs.mstate.pc, len(gs.mstate.stack),
+            len(gs.world_state.constraints),
+            int(gs.mstate.memory._msize))
+
+
+@pytest.fixture(scope="module")
+def captured_codes():
+    """One captured entry-state set per code, shared by the engine
+    identity tests (each capture is a full analysis — budget)."""
+    A = _fork_tree_code(3, 1)
+    B = _diamond_code(3)
+    return {"A": (A, _capture_entries(A)),
+            "B": (B, _capture_entries(B))}
+
+
+class TestEnginePackedIdentity:
+    def test_two_codes_match_solo_and_cover_per_member(
+            self, captured_codes):
+        A, sa = captured_codes["A"]
+        B, sb = captured_codes["B"]
+        solo_a = sorted(_state_sig(g) for g in
+                        lane_engine.LaneEngine(n_lanes=64)
+                        .explore(A, list(sa)))
+        solo_b = sorted(_state_sig(g) for g in
+                        lane_engine.LaneEngine(n_lanes=64)
+                        .explore(B, list(sb)))
+        ss = SolverStatistics()
+        saved0 = ss.dispatches_saved
+        # headroom width: solo runs get 64 lanes each, the packed
+        # wave gets the sum — capacity parity, not a perf knob
+        eng = lane_engine.LaneEngine(n_lanes=64)
+        out = eng.explore_packed(
+            [(A, list(sa), "req-a"), (B, list(sb), "req-b")])
+        assert sorted(_state_sig(g) for g in out["req-a"]) == solo_a
+        assert sorted(_state_sig(g) for g in out["req-b"]) == solo_b
+        assert ss.dispatches_saved > saved0
+        # per-member coverage slices landed out of the arena bitmap
+        va = eng.visited_by_code.get(A)
+        vb = eng.visited_by_code.get(B)
+        assert va is not None and va.shape[0] == len(A) and va.any()
+        assert vb is not None and vb.shape[0] == len(B) and vb.any()
+
+    @pytest.mark.slow
+    def test_within_tenant_merge_only(self, captured_codes):
+        """Twin-heavy members in one packed wave: each tenant's
+        exact-frontier merge fires (short windows keep rejoin twins
+        RUNNING at boundaries), the owner-homogeneity assert inside
+        _collapse_twins never trips, and per-tenant results match the
+        same-window solo runs. Slow-marked: the window=12 jit
+        variants are unique to this test and re-bill per-process
+        tracing on every tier-1 run; the owner-homogeneity assert
+        itself is armed in EVERY packed explore (incl. the tier-1
+        PackGroup suite), so cross-tenant merging still fails loudly
+        in-budget."""
+        B, sb = captured_codes["B"]
+        ss = SolverStatistics()
+        merged0 = ss.lanes_merged
+        out = lane_engine.LaneEngine(
+            n_lanes=64, window=12).explore_packed(
+            [(B, list(sb), "t1"), (B, list(sb), "t2")])
+        # tenant symmetry: identical members produce identical parked
+        # sets (packed-vs-solo identity is the default-window test);
+        # the diamond's twins really merged, within tenant only (a
+        # cross-tenant group would have tripped the assert)
+        t1 = sorted(_state_sig(g) for g in out["t1"])
+        t2 = sorted(_state_sig(g) for g in out["t2"])
+        assert t1 == t2 and t1
+        assert ss.lanes_merged > merged0
+
+
+# ---------------------------------------------------------------------------
+# per-tenant retire routing + the persistent worker pool
+# ---------------------------------------------------------------------------
+
+
+class TestTenantRouting:
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_router_delivers_per_owner_in_submit_order(self, workers):
+        router = TenantRouter(["t1", "t2"])
+        ring = RetireRing(workers=workers, capacity=8, sink=router)
+        import random
+
+        rng = random.Random(7)
+        expect = {"t1": [], "t2": []}
+        for i in range(12):
+            owner = "t1" if i % 2 else "t2"
+            delay = rng.uniform(0, 0.01) if workers > 1 else 0
+            expect[owner].append(i)
+
+            def pull(i=i, delay=delay):
+                time.sleep(delay)
+                return i
+
+            def build(payload, owner=owner):
+                return [(owner, payload)]
+
+            ring.submit(pull, build)
+        ring.flush()
+        assert router.lists["t1"] == [p for p in expect["t1"]]
+        assert router.lists["t2"] == [p for p in expect["t2"]]
+
+    def test_k1_spawns_zero_threads(self, monkeypatch):
+        monkeypatch.delenv("MTPU_MAT_WORKERS", raising=False)
+        before = list(retire_ring._POOL_THREADS)
+        ring = RetireRing(workers=1, sink=[])
+        ring.submit(lambda: 1, lambda p: [p])
+        ring.flush()
+        assert retire_ring._POOL_THREADS == before
+
+    def test_pool_persists_across_rings(self):
+        ss = SolverStatistics()
+        RetireRing(workers=2, sink=[])  # spawns (or reuses) the pool
+        reuses0 = ss.mat_pool_reuses
+        threads0 = list(retire_ring._POOL_THREADS)
+        sink = []
+        ring = RetireRing(workers=2, sink=sink)
+        ring.submit(lambda: 41, lambda p: [p + 1])
+        ring.flush()
+        assert sink == [42]
+        assert ss.mat_pool_reuses > reuses0
+        assert retire_ring._POOL_THREADS == threads0  # no respawn
+
+
+# ---------------------------------------------------------------------------
+# PackGroup interleaving
+# ---------------------------------------------------------------------------
+
+
+def _full_analysis(code, tx_count=1):
+    _reset_modules()
+    dis = MythrilDisassembler(eth=None)
+    address, _ = dis.load_from_bytecode(code.hex(), bin_runtime=True)
+    analyzer = MythrilAnalyzer(
+        disassembler=dis,
+        cmd_args=make_cmd_args(execution_timeout=120, tpu_lanes=64),
+        strategy="bfs", address=address)
+    lane_engine.PATH_HISTORY[code] = 64
+    report = analyzer.fire_lasers(modules=None,
+                                  transaction_count=tx_count)
+    out = json.loads(report.as_json())
+    return sorted((i.get("swc-id"), i.get("title"), i.get("address"))
+                  for i in out.get("issues") or [])
+
+
+class TestPackGroup:
+    def test_interleaved_members_match_sequential(self):
+        A = _fork_tree_code(3, 1)     # no issues
+        B = _diamond_code(3)          # one Exception State issue
+        seq = {"a": _full_analysis(A), "b": _full_analysis(B)}
+        ss = SolverStatistics()
+        packed0 = ss.waves_packed
+        group = wave_pack.PackGroup()
+        group.add_member("a", lambda: _full_analysis(A))
+        group.add_member("b", lambda: _full_analysis(B))
+        members = group.run()
+        for key in ("a", "b"):
+            assert members[key].error is None, members[key].error
+            assert members[key].result == seq[key]
+        assert ss.waves_packed > packed0
+        # issue no-bleed: the fork tree found nothing, the diamond's
+        # issue did not leak into it
+        assert seq["a"] == [] and len(seq["b"]) == 1
+
+    def test_counters_never_bleed_across_members(self):
+        A = _fork_tree_code(3, 1)
+        B = _diamond_code(3)
+        group = wave_pack.PackGroup()
+
+        def body(code):
+            SolverStatistics().bump(daemon_requests=1)
+            return _full_analysis(code)
+
+        group.add_member("a", lambda: body(A))
+        group.add_member("b", lambda: body(B))
+        members = group.run()
+        # the per-request attribution (snapshot/diff at every baton
+        # boundary) books exactly ONE daemon_requests per member —
+        # the solo c0/c1 diff would show every member's bump in every
+        # row (the bleed this satellite regresses against)
+        for key in ("a", "b"):
+            assert members[key].counters.get("daemon_requests") == 1
+        # wave work books to the shared bucket, not to a member
+        shared = group.shared_counters
+        member_windows = sum(
+            members[k].counters.get("lane_windows", 0)
+            for k in ("a", "b"))
+        assert shared.get("lane_windows", 0) >= 1
+        assert member_windows == 0
+
+
+# ---------------------------------------------------------------------------
+# daemon admission end to end
+# ---------------------------------------------------------------------------
+
+
+def _run_daemon_queue(tmp, codes, pack_on, monkeypatch):
+    """Serve the queue in-process; returns ({rid: report row},
+    counter deltas)."""
+    monkeypatch.setenv("MTPU_PACK", "1" if pack_on else "0")
+    d = AnalysisDaemon(tmp, workers=1)
+    t = threading.Thread(target=d.run, daemon=True)
+    t.start()
+    assert wait_ready(d.socket_path, 120)
+    client = DaemonClient(d.socket_path)
+    ss = SolverStatistics()
+    base = {k: getattr(ss, k) for k in
+            ("waves_packed", "lane_windows", "dispatches_saved")}
+    # a warm head request keeps the worker busy so the real queue
+    # packs (admission only folds SIMULTANEOUSLY pending requests)
+    warm = threading.Thread(target=lambda: DaemonClient(
+        d.socket_path).analyze(codes["warm"], tpu_lanes=64,
+                               timeout=120, transaction_count=1,
+                               id="warm"))
+    warm.start()
+    time.sleep(0.6)
+    rows = {}
+
+    def submit(rid, code):
+        rows[rid] = DaemonClient(d.socket_path).analyze(
+            code, tpu_lanes=64, timeout=120, transaction_count=1,
+            id=rid)
+
+    threads = [threading.Thread(target=submit, args=(rid, code))
+               for rid, code in codes.items() if rid != "warm"]
+    for s in threads:
+        s.start()
+    for s in threads:
+        s.join(timeout=300)
+    warm.join(timeout=300)
+    delta = {k: getattr(ss, k) - base[k] for k in base}
+    client.shutdown()
+    t.join(timeout=60)
+    return rows, delta
+
+
+def _canon_row(row):
+    return sorted((i["swc-id"], i.get("address"), i.get("function"))
+                  for i in row["issues"])
+
+
+class TestDaemonPacking:
+    @pytest.mark.slow
+    def test_packed_queue_identity_and_fewer_dispatches(
+            self, tmp_path, monkeypatch):
+        """Slow-marked: two in-process daemon lifecycles (~60 s).
+        bench.py --smoke stage 16 runs the same gates on every smoke
+        (identity packed vs unpacked vs one-shot, waves_packed,
+        strictly fewer dispatches, occupancy) — tier-1 keeps the
+        admission units + the PackGroup/engine identity suite."""
+        codes = {
+            "warm": _fork_tree_code(3, 1).hex(),
+            "ra": _fork_tree_code(4, 1).hex(),
+            "rb": _diamond_code(5).hex(),
+            "rc": _diamond_code(3).hex(),
+        }
+        rows_on, d_on = _run_daemon_queue(
+            tmp_path / "on", codes, True, monkeypatch)
+        rows_off, d_off = _run_daemon_queue(
+            tmp_path / "off", codes, False, monkeypatch)
+        # the same queue really packed: >=1 packed wave, dispatch
+        # savings booked, and STRICTLY fewer window dispatches than
+        # the one-request-per-wave serving of the identical queue
+        assert d_on["waves_packed"] >= 1
+        assert d_on["dispatches_saved"] >= 1
+        assert d_on["lane_windows"] < d_off["lane_windows"]
+        assert d_off["waves_packed"] == 0
+        # per-tenant identity: packed vs unpacked vs one-shot
+        for rid in ("ra", "rb", "rc"):
+            assert _canon_row(rows_on[rid]) == _canon_row(
+                rows_off[rid]), rid
+            oneshot = _full_analysis(bytes.fromhex(codes[rid]))
+            assert sorted(
+                (i["swc-id"], i.get("title"), i.get("address"))
+                for i in rows_on[rid]["issues"]) == oneshot, rid
+        # packed rows carry the group-attributed counters: exactly one
+        # daemon_requests each (the no-bleed regression, daemon side)
+        packed_rows = [r for r in rows_on.values() if r.get("packed")]
+        assert len(packed_rows) >= 2
+        for row in packed_rows:
+            assert row["counters"].get("daemon_requests") == 1
+
+    def test_pack_admission_requires_same_shape(self, tmp_path):
+        d = AnalysisDaemon(tmp_path / "shape", workers=1)
+        head = Request({"code": "6001", "tpu_lanes": 64})
+        peer = Request({"code": "6002", "tpu_lanes": 64, "id": "p"})
+        odd = Request({"code": "6003", "tpu_lanes": 64,
+                       "timeout": 99, "id": "o"})
+        host = Request({"code": "6004", "id": "h"})  # host mode
+        d._pending = [peer, odd, host]
+        got = d._pop_pack_peers(head)
+        assert [r.id for r in got] == ["p"]
+        assert [r.id for r in d._pending] == ["o", "h"]
+
+    def test_pack_gate_off_means_no_peers(self, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setenv("MTPU_PACK", "0")
+        d = AnalysisDaemon(tmp_path / "off", workers=1)
+        head = Request({"code": "6001", "tpu_lanes": 64})
+        d._pending = [Request({"code": "6002", "tpu_lanes": 64})]
+        assert d._pop_pack_peers(head) == []
+        assert len(d._pending) == 1
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM mid-pack -> per-request resume (slow: two daemon processes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSigtermMidPack:
+    def test_members_resume_independently(self, tmp_path):
+        out = tmp_path / "serve"
+        codes = {"ra": _fork_tree_code(4, 1).hex(),
+                 "rb": _diamond_code(5).hex(),
+                 "rc": _diamond_code(3).hex()}
+        env = dict(os.environ, JAX_PLATFORMS="cpu", MTPU_PACK="1")
+        env["MTPU_PATH_DELAY"] = "0.2"
+
+        def start(e):
+            return subprocess.Popen(
+                [sys.executable, "-m", "mythril_tpu", "serve",
+                 "--out-dir", str(out)],
+                env=e, cwd=str(REPO), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        proc = start(env)
+        from mythril_tpu.daemon import SOCKET_NAME
+
+        sock = str(out / SOCKET_NAME)
+        assert wait_ready(sock, 120)
+        events = {rid: [] for rid in codes}
+
+        def submit(rid):
+            try:
+                client = DaemonClient(sock)
+                for ev in client.submit(codes[rid], bin_runtime=True,
+                                        timeout=300, tpu_lanes=64,
+                                        transaction_count=1, id=rid):
+                    events[rid].append(ev)
+            except (DaemonError, OSError) as e:
+                events[rid].append({"event": "hangup",
+                                    "error": str(e)})
+
+        # head request occupies the worker; the other two queue and
+        # pack with it once it frees — to get all three in one pack,
+        # stagger: submit all three while the daemon is still
+        # compiling/warming the first
+        threads = [threading.Thread(target=submit, args=(rid,))
+                   for rid in codes]
+        for t in threads:
+            t.start()
+            time.sleep(0.2)
+        deadline = time.monotonic() + 180
+        while not all(any(e.get("event") == "started" for e in evs)
+                      for evs in events.values()):
+            assert time.monotonic() < deadline, events
+            time.sleep(0.1)
+        time.sleep(2.0)  # mid-flight (delayed rounds)
+        proc.send_signal(signal.SIGTERM)
+        proc.communicate(timeout=120)
+        for t in threads:
+            t.join(timeout=30)
+        queue = json.loads((out / "daemon_queue.json").read_text())
+        interrupted = {r["id"] for r in queue["interrupted"]}
+        assert interrupted, queue
+        # every in-flight member persisted as its own resumable row
+        assert interrupted <= set(codes)
+
+        env["MTPU_PATH_DELAY"] = "0"
+        proc2 = start(env)
+        try:
+            assert wait_ready(sock, 120)
+            client = DaemonClient(sock)
+            rows = {}
+            deadline = time.monotonic() + 300
+            while len(rows) < len(codes):
+                for rid in codes:
+                    if rid in rows:
+                        continue
+                    row = client.result(rid)
+                    if row.get("event") == "report":
+                        rows[rid] = row
+                assert time.monotonic() < deadline, rows.keys()
+                time.sleep(0.25)
+            client.shutdown()
+            proc2.communicate(timeout=60)
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+        for rid in codes:
+            expect = _full_analysis(bytes.fromhex(codes[rid]))
+            assert sorted(
+                (i["swc-id"], i.get("title"), i.get("address"))
+                for i in rows[rid]["issues"]) == expect, rid
+            if rid in interrupted:
+                assert rows[rid]["resumed"] is True

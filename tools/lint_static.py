@@ -110,6 +110,19 @@ touched), all of which an ad-hoc socket call site would silently
 skip. Engine, support, and orchestration layers talk to the daemon
 through ``daemon.client`` — or allowlist with a reason.
 
+Rule 10 — owner-tag-read-outside-ring (the ISSUE-15 wave-packing
+class): reading a per-lane owner tag (an ``.owner`` attribute load)
+anywhere in ``mythril_tpu/laser/`` outside
+``mythril_tpu/laser/retire_ring.py``. The ring's delivery seam
+(``owner_of`` / ``TenantRouter``) is the one sanctioned place tenant
+routing decisions are made — the same one-sanctioned-seam shape as
+rules 5/6/8/9: an ad-hoc owner peek is how a tenant's states (or
+issues, or counters) end up consumed under another tenant's identity
+without the submit-order and within-tenant-merge guarantees the ring
+enforces. Constructors/assignments are fine (the tag has to be
+stamped somewhere); non-lane ``owner`` fields (the pack coordinator's
+member records) allowlist with a reason.
+
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
 """
@@ -293,6 +306,27 @@ def _rule9_findings(rel: str, tree) -> List["Finding"]:
             flag(node, "construction ({})".format(fn.attr))
         elif fn.attr in _SOCKET_METHODS:
             flag(node, "call (.{})".format(fn.attr))
+    return out
+
+
+#: rule-10: the one module allowed to READ per-lane owner tags (the
+#: tenant routing seam — owner_of/TenantRouter live there)
+_RULE10_ROOT = "mythril_tpu/laser/"
+_RULE10_EXEMPT = "mythril_tpu/laser/retire_ring.py"
+
+
+def _rule10_findings(rel: str, tree) -> List["Finding"]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "owner" \
+                and isinstance(node.ctx, ast.Load):
+            out.append(Finding(
+                rel, node.lineno, "owner-tag-read-outside-ring",
+                "per-lane owner-tag read outside the sanctioned "
+                "routing seam (laser/retire_ring.owner_of / "
+                "TenantRouter) — ad-hoc owner peeks bypass the "
+                "ring's per-tenant delivery guarantees; route "
+                "through owner_of or allowlist with a reason"))
     return out
 
 
@@ -521,6 +555,9 @@ def lint_file(path: Path) -> List[Finding]:
     if rel.startswith("mythril_tpu/") and \
             not rel.startswith(_RULE9_EXEMPT):
         out.extend(_rule9_findings(rel, tree))
+
+    if rel.startswith(_RULE10_ROOT) and rel != _RULE10_EXEMPT:
+        out.extend(_rule10_findings(rel, tree))
 
     if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
         for node in ast.walk(tree):
