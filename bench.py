@@ -1480,6 +1480,108 @@ def _smoke_stream():
     return result
 
 
+def _smoke_codec():
+    """Stage 17: the shared-structure state-codec gate
+    (docs/state_codec.md).
+
+    The stage-12 diamond storm again — 2^7 sibling paths through a
+    32-lane engine, the shape whose lanes share all but O(1) of their
+    planes — analyzed four ways: {lane, host} x {MTPU_CODEC on, off}.
+    Gates:
+
+    * on the codec-on LANE run (the ring parks real already-pulled
+      row planes through ``encode_rows``): ``codec_bytes_encoded``
+      at least 4x below ``codec_bytes_raw`` — the storm's siblings
+      provably dedup — and ``codec_ref_hits > 0`` (columns actually
+      delta-encoded against the previous lane, not stored whole);
+    * issue sets IDENTICAL codec-on vs codec-off on the lane path
+      AND on the host path — the codec is a byte transform, never a
+      semantic one;
+    * off really off: not one codec counter moves across either
+      MTPU_CODEC=0 run.
+
+    Wall-clock is NOT gated (single-CPU container constraint): the
+    evidence is bytes-on-the-wire and avoided-copy counters."""
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.orchestration.mythril_analyzer import (
+        MythrilAnalyzer, reset_analysis_state,
+    )
+    from mythril_tpu.orchestration.mythril_disassembler import (
+        MythrilDisassembler,
+    )
+    from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+    from mythril_tpu.support import state_codec
+    from mythril_tpu.support.analysis_args import make_cmd_args
+
+    code = build_diamond_contract(k=7, dup_levels=0)
+    ss = SolverStatistics()
+    keys = ("codec_bytes_raw", "codec_bytes_encoded",
+            "codec_ref_hits", "codec_fallback_whole",
+            "codec_drop_whole")
+
+    def analyze(codec_on, lanes):
+        state_codec.FORCE = codec_on
+        try:
+            reset_analysis_state()
+            c0 = {k: getattr(ss, k) for k in keys}
+            dis = MythrilDisassembler(eth=None)
+            address, _ = dis.load_from_bytecode(code.hex(),
+                                                bin_runtime=True)
+            analyzer = MythrilAnalyzer(
+                disassembler=dis,
+                cmd_args=make_cmd_args(execution_timeout=120,
+                                       tpu_lanes=lanes),
+                strategy="bfs", address=address)
+            report = analyzer.fire_lasers(modules=None,
+                                          transaction_count=1)
+            return {
+                "issues": sorted((i.swc_id, i.address, i.title)
+                                 for i in report.issues.values()),
+                "codec": {k: getattr(ss, k) - c0[k] for k in keys},
+            }
+        finally:
+            state_codec.FORCE = None
+
+    lane_engine.PATH_HISTORY[code] = 128
+    lane_engine.FORCE_WIDTH = 32
+    try:
+        lane_engine.warm_variant(
+            32, len(code), {}, lane_engine.DEFAULT_WINDOW, 8192,
+            seed_bucket=16, block=True)
+        lane_on = analyze(True, 32)
+        lane_off = analyze(False, 32)
+    finally:
+        lane_engine.FORCE_WIDTH = None
+    host_on = analyze(True, 0)
+    host_off = analyze(False, 0)
+
+    cc = lane_on["codec"]
+    ratio = (cc["codec_bytes_raw"] / cc["codec_bytes_encoded"]
+             if cc["codec_bytes_encoded"] else 0.0)
+    off_moved = {k: v for run in (lane_off, host_off)
+                 for k, v in run["codec"].items() if v}
+    result = {
+        "lane_codec": cc,
+        "byte_ratio": round(ratio, 1),
+        "off_counters_moved": off_moved,
+        "issues_identical": {
+            "lane": lane_on["issues"] == lane_off["issues"],
+            "host": host_on["issues"] == host_off["issues"],
+        },
+        "issues": lane_on["issues"],
+    }
+    result["ok"] = bool(
+        ratio >= 4.0
+        and cc["codec_ref_hits"] > 0
+        and cc["codec_drop_whole"] == 0
+        and not off_moved
+        and result["issues_identical"]["lane"]
+        and result["issues_identical"]["host"]
+        and len(lane_on["issues"]) > 0
+    )
+    return result
+
+
 def build_static_dead_contract(k=5, tail=160):
     """k symbolic forks, one SELFDESTRUCT branch (the reachable issue),
     a final concrete SSTORE, then a long pure-arithmetic tail to STOP:
@@ -2993,6 +3095,15 @@ def bench_smoke():
        per fixture. Any miss exits 1; skippable via
        MTPU_SMOKE_PACK=0.
 
+    17. the state-codec gate (_smoke_codec, docs/state_codec.md): the
+       stage-12 diamond storm analyzed {lane, host} x {MTPU_CODEC on,
+       off} — the codec-on lane run gates codec_bytes_encoded at
+       least 4x below codec_bytes_raw with codec_ref_hits > 0 (the
+       storm's sibling planes provably dedup at the ring's parking
+       seam), issue identity codec-on vs codec-off on BOTH paths, and
+       zero codec-counter movement on the off runs. Any miss exits 1;
+       skippable via MTPU_SMOKE_CODEC=0.
+
     Prints ONE JSON line with the counter deltas; a perf regression in
     the discharge layer shows up as zeroed counters (or a solve-call
     count equal to the query count) without waiting on a corpus sweep."""
@@ -3283,6 +3394,19 @@ def bench_smoke():
     else:
         out["pack"] = {"skipped": True, "ok": True}
 
+    # stage 17: the state-codec gate (docs/state_codec.md): the
+    # diamond storm {lane, host} x {codec on, off} — >=4x byte ratio
+    # with ref hits at the ring's parking seam, issue identity on
+    # both paths, off really off; skippable via MTPU_SMOKE_CODEC=0
+    if os.environ.get("MTPU_SMOKE_CODEC", "1") != "0":
+        try:
+            out["codec"] = _smoke_codec()
+        except Exception as e:
+            out["codec"] = {"ok": False, "error": type(e).__name__,
+                            "detail": str(e)[:200]}
+    else:
+        out["codec"] = {"skipped": True, "ok": True}
+
     out["solver_batch"] = {
         k: round(v - c0.get(k, 0), 1)
         for k, v in ss.batch_counters().items()
@@ -3351,7 +3475,12 @@ def bench_smoke():
           # shared device waves (packed waves, saved dispatches,
           # strictly fewer windows, higher occupancy) with per-tenant
           # issue identity packed vs unpacked vs one-shot
-          and out["pack"].get("ok", False))
+          and out["pack"].get("ok", False)
+          # the state-codec gate: the storm's sibling planes provably
+          # dedup (>=4x byte ratio, nonzero ref hits), issue identity
+          # codec on/off on host and lane, and MTPU_CODEC=0 moves no
+          # codec counter
+          and out["codec"].get("ok", False))
     return 0 if ok else 1
 
 

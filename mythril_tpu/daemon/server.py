@@ -608,13 +608,15 @@ class AnalysisDaemon:
     @staticmethod
     def _pack_width_clamp() -> int:
         """Combined-width admission bound: the capacity autoprobe's
-        persisted clamp when one was ever recorded (docs/
-        drain_pipeline.md), else 0 = unbounded (pick_width still
-        right-sizes the packed wave)."""
+        tightest persisted clamp across shapes when any was ever
+        recorded (docs/drain_pipeline.md; clamps are per pow2 shape —
+        admission has no single request shape, so the conservative
+        min binds), else 0 = unbounded (pick_width still right-sizes
+        the packed wave per its own shape)."""
         try:
-            from ..parallel import cost_model
+            from ..laser.lane_engine import capacity_clamp
 
-            return int(cost_model.WIDTH_CLAMP or 0)
+            return int(capacity_clamp() or 0)
         except Exception:
             return 0
 

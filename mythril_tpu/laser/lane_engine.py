@@ -340,23 +340,40 @@ def mat_workers() -> int:
 # so subsequent runs — and the future daemon — never re-fault).
 # ---------------------------------------------------------------------------
 
-#: in-process clamp discovered by the autoprobe (None = no fault seen)
-CAPACITY_CLAMP: Optional[int] = None
-_FAULT_PROBED = False
+#: in-process clamps discovered by the autoprobe, keyed by the pow2
+#: shape of the faulted request ({} = no fault seen): a 256k fault's
+#: clamp binds 256k requests only — the 32k path that never faulted
+#: keeps its full width (PR 17; persisted per-shape via cost_model)
+CAPACITY_CLAMPS: Dict[int, int] = {}
+_FAULT_PROBED_SHAPES: set = set()
 _CLAMP_WARNED = False
 
 
-def capacity_clamp() -> Optional[int]:
-    """The live-width clamp in force: this process's probe result, else
-    the one a prior run persisted into stats.json (cost_model)."""
-    if CAPACITY_CLAMP is not None:
-        return CAPACITY_CLAMP
+def capacity_clamp(width: Optional[int] = None) -> Optional[int]:
+    """The live-width clamp binding a request of `width`: this
+    process's probe result for that pow2 shape, else the one a prior
+    run persisted into stats.json (cost_model, per-shape map — a
+    legacy scalar loads as the shape-blind entry and binds every
+    width). ``width=None`` returns the tightest clamp known from any
+    shape (admission-control callers without a concrete request)."""
     try:
         from ..parallel import cost_model
 
-        return cost_model.WIDTH_CLAMP
+        if width is None:
+            cands = list(CAPACITY_CLAMPS.values()) \
+                + list(cost_model.WIDTH_CLAMPS.values())
+            return min(cands) if cands else None
+        shape = cost_model.clamp_shape(width)
+        persisted = cost_model.width_clamp_for(width)
+        local = CAPACITY_CLAMPS.get(shape)
+        cands = [c for c in (local, persisted) if c is not None]
+        return min(cands) if cands else None
     except Exception:  # pragma: no cover - cost model optional
-        return None
+        if width is None:
+            return min(CAPACITY_CLAMPS.values()) \
+                if CAPACITY_CLAMPS else None
+        return CAPACITY_CLAMPS.get(
+            1 << (max(int(width), 1) - 1).bit_length())
 
 
 def _probe_width(width: int, lane_kwargs: Optional[dict] = None) -> bool:
@@ -388,13 +405,17 @@ def note_kernel_fault(width: int,
     """First kernel-fault fallback at `width`: re-probe that width in
     isolation (a transient failure that probes clean must NOT clamp),
     then bisect the pow2 widths below it for the largest stable one.
-    The clamp lands in CAPACITY_CLAMP + cost_model (stats.json) and is
-    logged at WARNING once. Runs at most once per process; returns the
-    clamp (None = no clamp)."""
-    global _FAULT_PROBED, CAPACITY_CLAMP
-    if _FAULT_PROBED or width < 128:
-        return CAPACITY_CLAMP
-    _FAULT_PROBED = True
+    The clamp lands in CAPACITY_CLAMPS + cost_model (stats.json),
+    keyed by the faulted request's pow2 shape — it binds THAT shape
+    only, so a 256k probe can't clamp the 32k path — and is logged at
+    WARNING once. Runs at most once per shape per process; returns
+    the clamp for this shape (None = no clamp)."""
+    from ..parallel import cost_model as _cm
+
+    shape = _cm.clamp_shape(width)
+    if shape in _FAULT_PROBED_SHAPES or width < 128:
+        return CAPACITY_CLAMPS.get(shape)
+    _FAULT_PROBED_SHAPES.add(shape)
     probe = probe or _probe_width
     try:
         if probe(width, lane_kwargs):
@@ -418,19 +439,20 @@ def note_kernel_fault(width: int,
                 hi = mid // 2
         if best is None:
             return None
-        CAPACITY_CLAMP = best
+        CAPACITY_CLAMPS[shape] = best
         try:
-            from ..parallel import cost_model
-
-            cost_model.record_width_clamp(best)
+            _cm.record_width_clamp(best, shape=shape)
         except Exception:  # pragma: no cover - cost model optional
             pass
         log.warning(
             "lane capacity autoprobe: %d-wide live windows fault this "
-            "worker; clamping pick_width to %d (persisted to "
-            "stats.json — subsequent runs clamp instead of re-faulting)",
-            width, best)
-        trace.event("lane.capacity_clamp", faulted=width, clamp=best)
+            "worker; clamping pick_width to %d for the %d-lane shape "
+            "(persisted per-shape to stats.json — subsequent runs at "
+            "this shape clamp instead of re-faulting; other shapes "
+            "are unaffected)",
+            width, best, shape)
+        trace.event("lane.capacity_clamp", faulted=width, clamp=best,
+                    shape=shape)
         return best
     except Exception as e:  # pragma: no cover - probe best-effort
         log.debug("capacity autoprobe failed: %s", e)
@@ -1735,21 +1757,24 @@ def pick_width(cap: int, n_entries: int,
     spill/refill path absorbs overflow
     (tests/test_lane_spill_refill.py). Worklists that genuinely grow
     pick a wider engine on the next sweep. A capacity-autoprobe clamp
-    (CAPACITY_CLAMP / stats.json via cost_model) caps the width below
-    any live-plane size that kernel-faulted this worker class — the
-    engine degrades through the spill/refill path instead of faulting
-    (logged at WARNING once when the clamp actually binds)."""
+    (CAPACITY_CLAMPS / stats.json via cost_model) caps the width below
+    any live-plane size that kernel-faulted this worker class AT THE
+    REQUESTED SHAPE — clamps are per pow2 shape, so a 256k fault's
+    clamp never narrows a 32k sweep — and the engine degrades through
+    the spill/refill path instead of faulting (logged at WARNING once
+    when the clamp actually binds)."""
     global _CLAMP_WARNED
     if FORCE_WIDTH is not None:
         return max(min(cap, FORCE_WIDTH), 1)
-    clamp = capacity_clamp()
+    clamp = capacity_clamp(cap)
     if clamp is not None and clamp < cap:
         if not _CLAMP_WARNED:
             _CLAMP_WARNED = True
             log.warning(
                 "lane width capped at %d by the capacity autoprobe "
-                "(configured cap %d kernel-faulted a worker; "
-                "overflow degrades via spill/refill)", clamp, cap)
+                "(configured cap %d kernel-faulted a worker at that "
+                "shape; overflow degrades via spill/refill)",
+                clamp, cap)
         cap = max(clamp, 1)
     if cap <= 64:
         return max(cap, 1)
@@ -3696,7 +3721,11 @@ class LaneEngine:
                 return out
 
             build.ring_items = items  # SIGTERM live-dump introspection
-            ring.submit(pull, build)
+            # already-pulled chunks hand the ring their host rows so
+            # it can park them codec-encoded (state_codec.encode_rows)
+            # instead of holding raw planes until flush
+            ring.submit(pull, build,
+                        payload=rows_ref if floors is None else None)
 
         # overlapped fork-feasibility screening (batched discharge,
         # gated like the host's fork pruning): queries collected at

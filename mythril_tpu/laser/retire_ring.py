@@ -194,10 +194,35 @@ class RetireRing:
 
     # -- engine side ---------------------------------------------------------
 
-    def submit(self, pull: Callable, build: Callable) -> None:
+    def submit(self, pull: Callable, build: Callable,
+               payload=None) -> None:
         """Queue one retired chunk for ordered delivery. When the ring
         is full the OLDEST pending entry is delivered inline first
-        (bounded deferral; the overlap lost is one chunk's worth)."""
+        (bounded deferral; the overlap lost is one chunk's worth).
+
+        ``payload`` is the chunk's ALREADY-PULLED host row dict, when
+        the engine has one (the fast-retire path): the ring parks it
+        through the state codec (support/state_codec.py) — sibling
+        lanes share all but O(1) of their planes, so the rows the ring
+        retains between submit and flush compress per-column against
+        the previous lane. ``pull`` stays the fallback when the codec
+        declines (off, or no byte win); deferred device pulls
+        (payload None) are untouched — their bytes live on the device
+        until flush."""
+        if payload is not None and self.workers == 1:
+            # K>=2 rings materialize at submit on the worker pool —
+            # nothing is parked long enough to be worth encoding
+            try:
+                from ..support import state_codec
+
+                blob = state_codec.encode_rows(payload)
+            except Exception:  # codec trouble never stalls retire
+                blob = None
+            if blob is not None:
+                from ..support.state_codec import decode_rows
+
+                def pull(_blob=blob):  # noqa: F811 - parked form
+                    return decode_rows(_blob)
         while len(self._pending) >= self.capacity:
             self._deliver_one()
         job = _Job(self._seq, pull, build)

@@ -78,34 +78,77 @@ def record_host_peak(code_obj, peak: int) -> None:
     if code and peak > HOST_PEAKS.get(code, 0):
         HOST_PEAKS[code] = peak
 
-#: live-width clamp discovered by the lane engine's capacity autoprobe
-#: (lane_engine.note_kernel_fault): the largest plane width that
-#: probed stable after a kernel-fault fallback. Persisted into
-#: stats.json beside the cost model so subsequent runs (and the future
-#: daemon's schedulers) clamp pick_width instead of re-faulting.
+#: live-width clamps discovered by the lane engine's capacity
+#: autoprobe (lane_engine.note_kernel_fault), keyed by the pow2
+#: REQUEST shape that faulted (0 = the legacy shape-blind scalar, kept
+#: for old stats files and old warm entries). A 256k probe's clamp
+#: binds only 256k requests — a transient large-shape fault must not
+#: starve the 32k path that never faulted (each shape pays at most
+#: one probe session instead). Persisted into stats.json beside the
+#: cost model so subsequent runs (and the daemon's schedulers) clamp
+#: pick_width instead of re-faulting.
+WIDTH_CLAMPS: Dict[int, int] = {}
+
+#: legacy mirror of the shape-blind entry (WIDTH_CLAMPS[0]) — old
+#: readers (pre-map warm entries) keep working; new code should call
+#: width_clamp_for.
 WIDTH_CLAMP: Optional[int] = None
 
 
-def record_width_clamp(width: int) -> None:
-    """Record an autoprobe clamp (running min — a tighter bound from
-    any source wins)."""
+def clamp_shape(width: int) -> int:
+    """The pow2 clamp bucket of a requested width."""
+    width = max(int(width), 1)
+    return 1 << (width - 1).bit_length()
+
+
+def record_width_clamp(width: int, shape: Optional[int] = None) -> None:
+    """Record an autoprobe clamp (running min per shape — a tighter
+    bound from any source wins). ``shape`` is the pow2 request shape
+    whose probe session produced it; None records the legacy
+    shape-blind entry (applies to every shape, as before PR 17)."""
     global WIDTH_CLAMP
-    if width and (WIDTH_CLAMP is None or width < WIDTH_CLAMP):
-        WIDTH_CLAMP = int(width)
+    if not width:
+        return
+    key = clamp_shape(shape) if shape else 0
+    cur = WIDTH_CLAMPS.get(key)
+    if cur is None or width < cur:
+        WIDTH_CLAMPS[key] = int(width)
+    if key == 0:
+        WIDTH_CLAMP = WIDTH_CLAMPS[0]
 
 
-def load_width_clamp(out_dir) -> Optional[int]:
-    """Seed WIDTH_CLAMP from a prior run's stats.json (corpus warm
-    start — called beside load_stats). Returns the clamp in force."""
+def width_clamp_for(width: int) -> Optional[int]:
+    """The clamp binding a request of `width`: the entry for its own
+    pow2 shape and the legacy shape-blind entry (key 0), whichever is
+    tighter; None when neither exists. Entries for OTHER shapes never
+    bind — the per-shape map exists precisely so a 256k fault cannot
+    clamp the 32k path."""
+    cands = [v for k, v in WIDTH_CLAMPS.items()
+             if k == 0 or k == clamp_shape(width)]
+    return min(cands) if cands else None
+
+
+def load_width_clamp(out_dir) -> Optional[Dict[int, int]]:
+    """Seed WIDTH_CLAMPS from a prior run's stats.json (corpus warm
+    start — called beside load_stats). The persisted value is a
+    per-shape map ({"<pow2 shape>": clamp}); a legacy scalar (pre-map
+    stats file) still loads, as the shape-blind key-0 entry. Returns
+    the map in force (empty dict = no clamp)."""
     path = Path(out_dir) / STATS_NAME
     try:
         if path.exists():
             clamp = json.loads(path.read_text()).get("lane_width_clamp")
-            if clamp:
+            if isinstance(clamp, dict):
+                for key, val in clamp.items():
+                    if val:
+                        record_width_clamp(
+                            int(val),
+                            shape=int(key) if int(key) else None)
+            elif clamp:
                 record_width_clamp(int(clamp))
     except Exception as e:  # pragma: no cover - warm start best-effort
         log.debug("width-clamp load failed: %s", e)
-    return WIDTH_CLAMP
+    return dict(WIDTH_CLAMPS)
 
 
 STATS_NAME = "stats.json"
@@ -185,21 +228,33 @@ def save_stats(out_dir, results: Sequence[dict],
         except Exception:
             telemetry = None
     payload = {"version": 1, "contracts": prior}
-    # capacity-autoprobe clamp (running min over prior runs): the
-    # engine side reads it back through load_width_clamp/WIDTH_CLAMP
-    # so a width that faulted once never faults this fleet again
-    prior_clamp = None
+    # capacity-autoprobe clamps (running min per pow2 request shape
+    # over prior runs): the engine side reads them back through
+    # load_width_clamp/width_clamp_for so a shape that faulted once
+    # never faults this fleet again — and a shape that never faulted
+    # is never clamped by another's probe. A legacy scalar prior (or
+    # one written by a pre-map build) merges as the shape-blind key-0
+    # entry, and the persisted value is a {"<shape>": clamp} map.
+    merged: Dict[int, int] = dict(WIDTH_CLAMPS)
     try:
         old = Path(out) / STATS_NAME
         if old.exists():
             prior_clamp = json.loads(old.read_text()).get(
                 "lane_width_clamp")
+            if isinstance(prior_clamp, dict):
+                items = ((int(k), v) for k, v in prior_clamp.items())
+            elif prior_clamp:
+                items = ((0, prior_clamp),)
+            else:
+                items = ()
+            for key, val in items:
+                if val and (key not in merged or int(val) < merged[key]):
+                    merged[key] = int(val)
     except Exception:
-        prior_clamp = None
-    clamp = min((c for c in (prior_clamp, WIDTH_CLAMP) if c),
-                default=None)
-    if clamp:
-        payload["lane_width_clamp"] = int(clamp)
+        pass
+    if merged:
+        payload["lane_width_clamp"] = {
+            str(k): v for k, v in sorted(merged.items())}
     if telemetry:
         payload["telemetry"] = telemetry
     try:

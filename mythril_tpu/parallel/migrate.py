@@ -411,7 +411,13 @@ class MigrationBus:
             from ..support.checkpoint import save_verdict_sidecar
 
             entries = self._entries_for(chunk, ship)
-            if entries and save_verdict_sidecar(side, entries):
+            # the sidecar REFERENCES the batch's shared term table
+            # (state codec): its entries' terms are mostly the shipped
+            # states' constraint prefixes, so it ships only the rows
+            # it adds. A thief that finds the batch missing or skewed
+            # drops the sidecar whole and re-proves.
+            if entries and save_verdict_sidecar(side, entries,
+                                                table_from=batch):
                 paths.append(side)
         # static-pass results ship like verdict sidecars
         # (docs/static_pass.md): pure per-code-hash data, so the

@@ -60,6 +60,16 @@ log = logging.getLogger(__name__)
 #: references would duplicate module singletons.
 VERSION = 4
 
+#: v5: the body is a state_codec frame — ONE shared term table for the
+#: whole snapshot with every open/in-flight state delta-encoded
+#: against a codec-chosen reference state (docs/state_codec.md).
+#: Written only when the codec gate is on (MTPU_CODEC, default on;
+#: =0 writes v4 bit-for-bit); loads accept BOTH versions regardless of
+#: the gate — reading what is on disk is a correctness obligation.  A
+#: corrupt/skewed v5 body drops WHOLE (fresh run), like any other
+#: malformed snapshot.
+VERSION_CODEC = 5
+
 #: observability: how many loads resumed vs fell back to fresh runs
 RESUME_STATS = {"loaded": 0, "failed": 0}
 
@@ -141,11 +151,13 @@ class _Unpickler(pickle.Unpickler):
         return None  # nodes / dynloaders restore as absent
 
 
-def _dag_rows(roots):
+def _dag_rows(roots, seen=None):
     """Iterative post-order over the term DAG: every node's row comes
-    after its arguments' rows."""
+    after its arguments' rows.  `seen` pre-seeds the visited set with
+    tids an external base table already carries (state_codec frames
+    referencing another file's table emit only the rows they add)."""
     rows = []
-    seen = set()
+    seen = set() if seen is None else seen
     stack = [(t, False) for t in roots]
     while stack:
         t, emit = stack.pop()
@@ -222,20 +234,38 @@ def load_with_terms(stream):
         _LOAD_TERMS = {}
 
 
-def save_verdict_sidecar(path, entries) -> bool:
+def save_verdict_sidecar(path, entries, table_from=None) -> bool:
     """Atomically write a migration batch's verdict-cache sidecar:
     ``(ordered terms, verdict, model)`` triples from
     VerdictCache.export_entries, term-safe pickled (the terms travel as
     flat-table rows and re-intern on the thief — fingerprints are
-    process-local tids and must re-derive there). Best-effort: a
+    process-local tids and must re-derive there). With the state codec
+    on, the sidecar is a codec frame; ``table_from`` names a sibling
+    codec payload (the offer batch) whose term table the sidecar
+    REFERENCES instead of re-shipping — its entries' terms are mostly
+    the shipped states' constraint prefixes, so the sidecar carries
+    only the rows it adds (docs/state_codec.md). Best-effort: a
     sidecar failure must never block the batch it rides with."""
+    from . import state_codec
+
     try:
         path = str(path)
         fd, tmp = tempfile.mkstemp(
             dir=os.path.dirname(os.path.abspath(path)) or ".",
             prefix=".vsc-")
         with os.fdopen(fd, "wb") as f:
-            dump_with_terms(f, list(entries))
+            if state_codec.enabled():
+                table_base = None
+                if table_from is not None:
+                    got = state_codec.frame_table_blob(table_from)
+                    if got is not None:
+                        table_base = (
+                            os.path.basename(str(table_from)), got[0])
+                f.write(state_codec.encode_frame(
+                    {"kind": "verdicts"}, list(entries),
+                    table_base=table_base))
+            else:
+                dump_with_terms(f, list(entries))
         os.replace(tmp, path)
         return True
     except Exception as e:
@@ -246,12 +276,23 @@ def save_verdict_sidecar(path, entries) -> bool:
 
 def load_verdict_sidecar(path) -> list:
     """Inverse of save_verdict_sidecar; absent/corrupt sidecars load as
-    empty (the thief just re-proves — degraded, never wrong)."""
+    empty (the thief just re-proves — degraded, never wrong). Codec
+    frames resolve referenced term tables against sibling files in the
+    sidecar's own directory; a missing or hash-skewed reference drops
+    the sidecar WHOLE."""
+    from . import state_codec
+
     try:
         if not os.path.exists(str(path)):
             return []
         with open(str(path), "rb") as f:
-            return list(load_with_terms(f))
+            data = f.read()
+        if state_codec.is_frame(data):
+            _meta, parts = state_codec.decode_frame(
+                data, table_loader=state_codec.file_table_loader(
+                    os.path.dirname(os.path.abspath(str(path)))))
+            return list(parts)
+        return list(load_with_terms(io.BytesIO(data)))
     except Exception as e:
         log.warning("verdict sidecar load failed (%s); replaying "
                     "nothing", e)
@@ -357,39 +398,64 @@ def save_checkpoint(path: str, round_index: int, open_states,
     the file landed."""
     from ..laser.transaction import tx_id_manager
 
+    from . import state_codec
+
     inflight = list(inflight or [])
+    open_states = list(open_states)
     try:
         with trace.span("ckpt.export", states=len(open_states),
                         inflight=len(inflight)):
-            body = io.BytesIO()
-            pickler = _Pickler(body, protocol=pickle.HIGHEST_PROTOCOL)
-            pickler.dump({
-                "round": round_index,
-                "open_states": list(open_states),
-                "inflight": inflight,
-                "target_address": target_address,
-                "tx_counter": tx_id_manager._next,
-                "keccak": _keccak_state(),
-                "modules": _module_state() if include_modules else {},
-            })
-            head = io.BytesIO()
-            pickle.dump(
-                {"version": VERSION, "code_id": code_id,
-                 "terms": _dag_rows(pickler.roots.values())},
-                head, protocol=pickle.HIGHEST_PROTOCOL)
+            if state_codec.enabled():
+                # v5: one shared term table for the whole snapshot,
+                # states delta-chained (docs/state_codec.md)
+                meta = {
+                    "round": round_index,
+                    "n_open": len(open_states),
+                    "target_address": target_address,
+                    "tx_counter": tx_id_manager._next,
+                    "keccak": _keccak_state(),
+                    "modules": _module_state() if include_modules
+                    else {},
+                }
+                body_bytes = state_codec.encode_frame(
+                    meta, open_states + inflight)
+                head = io.BytesIO()
+                pickle.dump({"version": VERSION_CODEC,
+                             "code_id": code_id},
+                            head, protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                body = io.BytesIO()
+                pickler = _Pickler(body,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+                pickler.dump({
+                    "round": round_index,
+                    "open_states": open_states,
+                    "inflight": inflight,
+                    "target_address": target_address,
+                    "tx_counter": tx_id_manager._next,
+                    "keccak": _keccak_state(),
+                    "modules": _module_state() if include_modules
+                    else {},
+                })
+                body_bytes = body.getvalue()
+                head = io.BytesIO()
+                pickle.dump(
+                    {"version": VERSION, "code_id": code_id,
+                     "terms": _dag_rows(pickler.roots.values())},
+                    head, protocol=pickle.HIGHEST_PROTOCOL)
 
             fd, tmp = tempfile.mkstemp(
                 dir=os.path.dirname(os.path.abspath(path)) or ".",
                 prefix=".ckpt-")
             with os.fdopen(fd, "wb") as f:
                 f.write(head.getvalue())
-                f.write(body.getvalue())
+                f.write(body_bytes)
             os.replace(tmp, path)
         log.info(
             "checkpoint: round %d, %d open + %d in-flight states -> "
             "%s (%d bytes)",
             round_index, len(open_states), len(inflight), path,
-            head.tell() + body.tell())
+            head.tell() + len(body_bytes))
         return True
     except Exception as e:  # pragma: no cover - best-effort by design
         log.warning("checkpoint save failed (%s); continuing", e)
@@ -410,7 +476,7 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
     try:
         with trace.span("ckpt.import"), open(path, "rb") as f:
             head = pickle.load(f)
-            if head.get("version") != VERSION:
+            if head.get("version") not in (VERSION, VERSION_CODEC):
                 # version skew (old rank in a mixed-build fleet, or a
                 # pre-v4 file on disk): skipped, never crashed on —
                 # the run starts fresh and overwrites it
@@ -423,11 +489,24 @@ def load_checkpoint(path: str, code_id: str) -> Optional[Dict[str, Any]]:
                     "checkpoint %s belongs to different code; ignoring",
                     path)
                 return None
-            _LOAD_TERMS = _intern_rows(head["terms"])
-            try:
-                payload = _Unpickler(f).load()
-            finally:
-                _LOAD_TERMS = {}
+            if head.get("version") == VERSION_CODEC:
+                # codec frame body: shared table + delta-chained
+                # states. Any malformation raises (CodecError or
+                # otherwise) into the outer handler — the snapshot is
+                # dropped WHOLE, never partially adopted.
+                from . import state_codec
+
+                meta, parts = state_codec.decode_frame(f.read())
+                n_open = int(meta["n_open"])
+                payload = dict(meta)
+                payload["open_states"] = parts[:n_open]
+                payload["inflight"] = parts[n_open:]
+            else:
+                _LOAD_TERMS = _intern_rows(head["terms"])
+                try:
+                    payload = _Unpickler(f).load()
+                finally:
+                    _LOAD_TERMS = {}
 
         # parse everything up front: a malformed payload must not
         # leave half-restored global state behind

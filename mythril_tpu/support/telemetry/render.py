@@ -105,6 +105,15 @@ GROUPS: Sequence[Tuple[str, str, Gate, Tuple[Tuple[str, str], ...]]] = (
         ("spill_merged", "spill_merged_lanes"),
         ("ring_high_water", "ring_high_water"),
     )),
+    ("State codec", "docs/state_codec.md",
+     ("codec_bytes_raw", "codec_bytes_encoded", "codec_ref_hits",
+      "codec_drop_whole"), (
+        ("raw_bytes", "codec_bytes_raw"),
+        ("encoded_bytes", "codec_bytes_encoded"),
+        ("ref_hits", "codec_ref_hits"),
+        ("whole", "codec_fallback_whole"),
+        ("dropped", "codec_drop_whole"),
+    )),
     ("Warm store", "docs/warm_store.md",
      ("warm_hits", "warm_misses", "verdicts_warmed",
       "static_warmed", "route_first_try_wins"), (

@@ -61,6 +61,7 @@ store" render group -> bench detail -> shard reports -> corpus
 aggregate).
 """
 
+import io
 import logging
 import os
 import tempfile
@@ -236,6 +237,7 @@ def _write_entry(key: str, payload: dict) -> bool:
     if path is None:
         return False
     try:
+        from . import state_codec
         from .checkpoint import dump_with_terms
 
         path.parent.mkdir(parents=True, exist_ok=True)
@@ -244,7 +246,19 @@ def _write_entry(key: str, payload: dict) -> bool:
                                        prefix=".warm-")
             try:
                 with os.fdopen(fd, "wb") as f:
-                    dump_with_terms(f, payload)
+                    if state_codec.enabled():
+                        # codec frame: the verdict-bank entries (the
+                        # entry's bulk — sibling constraint prefixes)
+                        # delta-chain against one shared term table
+                        # (docs/state_codec.md); the rest of the
+                        # payload rides as frame meta
+                        verdicts = list(payload.get("verdicts", ()))
+                        meta = {k: v for k, v in payload.items()
+                                if k != "verdicts"}
+                        f.write(state_codec.encode_frame(
+                            meta, verdicts))
+                    else:
+                        dump_with_terms(f, payload)
                 os.replace(tmp, path)
             except BaseException:
                 try:
@@ -267,10 +281,19 @@ def _read_entry(key: str) -> Optional[dict]:
     if path is None or not path.exists():
         return None
     try:
+        from . import state_codec
         from .checkpoint import STATIC_SIDECAR_SHAPE, load_with_terms
 
         with open(path, "rb") as f:
-            payload = load_with_terms(f)
+            data = f.read()
+        if state_codec.is_frame(data):
+            # codec frame (written gate-on): meta + verdict parts.
+            # CodecError propagates into the drop-whole handler below.
+            meta, verdicts = state_codec.decode_frame(data)
+            payload = dict(meta)
+            payload["verdicts"] = list(verdicts)
+        else:
+            payload = load_with_terms(io.BytesIO(data))
         if not isinstance(payload, dict):
             log.info("warm store %s: malformed payload — dropped",
                      path.name)
@@ -421,9 +444,18 @@ def begin_analysis(contract) -> bool:
                         PATH_HISTORY[code] = peak
                 except Exception:
                     pass  # lane path optional
-        clamp = cost.get("width_clamp")
-        if clamp:
-            cost_model.record_width_clamp(int(clamp))
+        clamps = cost.get("width_clamps")
+        if isinstance(clamps, dict):
+            for shape, clamp in clamps.items():
+                if clamp:
+                    cost_model.record_width_clamp(
+                        int(clamp),
+                        shape=int(shape) if int(shape) else None)
+        else:
+            # pre-map entry: the scalar loads as the shape-blind clamp
+            clamp = cost.get("width_clamp")
+            if clamp:
+                cost_model.record_width_clamp(int(clamp))
     except Exception as e:
         log.debug("warm cost seed failed: %s", e)
 
@@ -497,7 +529,13 @@ def _save_current() -> bool:
         peak = cost_model.observed_fork_peak(dis) if dis is not None \
             else 0
         payload["cost"] = {"fork_peak": int(peak),
-                           "width_clamp": cost_model.WIDTH_CLAMP}
+                           # legacy scalar (shape-blind entry) rides
+                           # for pre-map readers; the per-shape map is
+                           # what new runs adopt
+                           "width_clamp": cost_model.WIDTH_CLAMP,
+                           "width_clamps": {
+                               str(k): v for k, v in
+                               cost_model.WIDTH_CLAMPS.items()}}
     except Exception as e:
         log.debug("warm cost export failed: %s", e)
     return _write_entry(ctx["key"], payload)
@@ -696,3 +734,88 @@ def gc_store(path=None, max_entries: Optional[int] = None,
                  len(survivors))
     return {"dir": str(d), "kept": len(survivors),
             "removed": removed, "dry_run": dry_run}
+
+
+#: flight-recorder artifacts the age cap sweeps (crash dumps are
+#: post-mortem material — useful while fresh, landfill after)
+_FLIGHTREC_PATTERNS = ("resume_rank*.ckpt", "trace_rank*.json",
+                       "events_rank*.jsonl", "metrics_rank*.json",
+                       "inflight_rank*.json", "crash_rank*.json")
+
+
+def gc_flightrec(path, max_entries: Optional[int] = None,
+                 max_age_days: Optional[float] = None,
+                 dry_run: bool = False) -> dict:
+    """Cap a crash flight recorder's dump directory
+    (``<out-dir>/flightrec/`` — support/telemetry/flightrec.py) by the
+    SAME count/age/LRU policy the warm-store GC applies: dump
+    artifacts older than the age cap go; ``resume_rank*.ckpt`` live
+    checkpoints beyond the count cap go oldest-first (mtime LRU — a
+    resumable rank rewrites its file on every dump, so mtime tracks
+    liveness).  A ``*.ckpt.verdicts`` sidecar whose checkpoint is
+    gone — GC'd now, resumed-and-removed earlier, or never landed —
+    is an orphan and goes too: it can never be replayed without the
+    snapshot it rode with.  ``dry_run`` reports without unlinking.
+    Returns a summary dict (tools/warm_gc.py --flightrec)."""
+    d = Path(path) if path else None
+    if d is None or not d.is_dir():
+        return {"dir": str(d) if d else None, "kept": 0,
+                "removed": [], "orphan_sidecars": [],
+                "dry_run": dry_run}
+    if max_entries is None:
+        max_entries = GC_MAX_ENTRIES
+    if max_age_days is None:
+        max_age_days = GC_MAX_AGE_DAYS
+    files = []
+    for pattern in _FLIGHTREC_PATTERNS:
+        for f in d.glob(pattern):
+            try:
+                files.append((f.stat().st_mtime, f))
+            except OSError:
+                continue
+    files.sort()  # oldest first
+    now = time.time()
+    doomed: List[Path] = []
+    survivors: List[Path] = []
+    for mtime, f in files:
+        if max_age_days and now - mtime > max_age_days * 86400.0:
+            doomed.append(f)
+        else:
+            survivors.append(f)
+    if max_entries is not None:
+        ckpts = [f for f in survivors if f.suffix == ".ckpt"]
+        if len(ckpts) > max_entries:
+            extra = set(ckpts[: len(ckpts) - max_entries])
+            doomed.extend(f for f in survivors if f in extra)
+            survivors = [f for f in survivors if f not in extra]
+    removed = []
+    for f in doomed:
+        if not dry_run:
+            try:
+                f.unlink()
+            except OSError:
+                continue
+        removed.append(f.name)
+    # orphan sweep: sidecars whose checkpoint no longer exists (or is
+    # doomed this pass — dry-run reasons about the hypothetical state)
+    doomed_names = {f.name for f in doomed}
+    orphans = []
+    for sc in sorted(d.glob("*.ckpt.verdicts")):
+        ckpt_name = sc.name[: -len(".verdicts")]
+        alive = (d / ckpt_name).exists() \
+            and ckpt_name not in doomed_names
+        if alive:
+            continue
+        if not dry_run:
+            try:
+                sc.unlink()
+            except OSError:
+                continue
+        orphans.append(sc.name)
+    if (removed or orphans) and not dry_run:
+        log.info("flightrec gc: removed %d dump(s) + %d orphaned "
+                 "sidecar(s) (%d kept)",
+                 len(removed), len(orphans), len(survivors))
+    return {"dir": str(d), "kept": len(survivors),
+            "removed": removed, "orphan_sidecars": orphans,
+            "dry_run": dry_run}

@@ -496,3 +496,86 @@ def test_owner_read_outside_laser_ok(tmp_path):
             return req.owner
     """)
     assert findings == []
+
+
+# ---------------------------------------------------------------- rule 11
+
+
+def test_state_serialize_primitive_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/spill.py", """\
+        from mythril_tpu.support import checkpoint as ckpt
+
+        def flatten(roots):
+            return ckpt._dag_rows(roots)
+    """)
+    assert [f.rule for f in findings] == ["state-serialize-outside-codec"]
+    assert findings[0].line == 4
+
+
+def test_state_delta_primitive_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/support/spool.py", """\
+        from mythril_tpu.support.state_codec import _delta_apply
+
+        def rehydrate(ref, rec):
+            return _delta_apply(ref, rec)
+    """)
+    assert [f.rule for f in findings] == ["state-serialize-outside-codec"]
+
+
+def test_term_pickler_instantiation_flagged(tmp_path):
+    findings = _lint_source(tmp_path, "mythril_tpu/ops/dump.py", """\
+        import io
+        from mythril_tpu.support import checkpoint as ckpt
+
+        def raw(obj):
+            buf = io.BytesIO()
+            ckpt._Pickler(buf).dump(obj)
+            return buf.getvalue()
+    """)
+    rules = [f.rule for f in findings]
+    assert "state-serialize-outside-codec" in rules
+
+
+def test_state_serialize_in_codec_exempt(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/support/state_codec.py", """\
+        from mythril_tpu.support import checkpoint as ckpt
+
+        def table(roots):
+            return ckpt._dag_rows(roots)
+    """)
+    assert findings == []
+
+
+def test_state_serialize_in_checkpoint_exempt(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/support/checkpoint.py", """\
+        def _dag_rows(roots, seen=None):
+            return []
+
+        def table(roots):
+            return _dag_rows(roots)
+    """)
+    assert findings == []
+
+
+def test_codec_public_surface_ok(tmp_path):
+    # the frame/rows API IS the sanctioned way to serialize planes
+    findings = _lint_source(tmp_path, "mythril_tpu/laser/park.py", """\
+        from mythril_tpu.support import state_codec
+
+        def park(meta, parts):
+            return state_codec.encode_frame(meta, parts)
+    """)
+    assert findings == []
+
+
+def test_raw_pickle_in_codec_exempt(tmp_path):
+    findings = _lint_source(
+        tmp_path, "mythril_tpu/support/state_codec.py", """\
+        import pickle
+
+        def freeze(rows):
+            return pickle.dumps(rows)
+    """)
+    assert findings == []

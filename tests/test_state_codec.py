@@ -1,0 +1,353 @@
+"""support/state_codec.py: the shared-structure state codec
+(docs/state_codec.md). Covers the frame contract (one shared term
+table, tid re-intern identity, delta-vs-whole equivalence), the
+drop-whole guarantee per malformed class, all four payload seams
+against their MTPU_CODEC=0 legacy formats, and the off-really-off
+gate (zero counters, legacy bytes)."""
+
+import io
+import os
+import pickle
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.smt import terms as T
+from mythril_tpu.smt.solver.solver_statistics import SolverStatistics
+from mythril_tpu.support import checkpoint as ckpt
+from mythril_tpu.support import state_codec as sc
+
+
+@pytest.fixture
+def codec_on(monkeypatch):
+    monkeypatch.setattr(sc, "FORCE", True)
+
+
+@pytest.fixture
+def codec_off(monkeypatch):
+    monkeypatch.setattr(sc, "FORCE", False)
+
+
+def _counters():
+    ss = SolverStatistics()
+    return {k: getattr(ss, k) for k in (
+        "codec_bytes_raw", "codec_bytes_encoded", "codec_ref_hits",
+        "codec_fallback_whole", "codec_drop_whole")}
+
+
+def _term_chain(tag, n=6):
+    t = T.bv_var("base_%s" % tag, 256)
+    for i in range(n):
+        t = T.mk_add(t, T.bv_const(i + 1, 256))
+    return t
+
+
+def _sibling_parts(n=8):
+    """n dict 'states' forked off one shared constraint prefix —
+    the shape every seam actually ships."""
+    shared = _term_chain("shared", 10)
+    parts = []
+    for i in range(n):
+        own = T.mk_eq(T.mk_add(shared, T.bv_const(i, 256)),
+                      T.bv_var("storage_%d" % i, 256))
+        parts.append({"idx": i, "prefix": shared, "own": own,
+                      "pad": b"\x00" * 64})
+    return shared, parts
+
+
+# ------------------------------------------------------------- frames
+
+
+def test_roundtrip_preserves_tid_identity(codec_on):
+    shared, parts = _sibling_parts(4)
+    blob = sc.encode_frame({"kind": "t"}, parts)
+    meta, out = sc.decode_frame(blob)
+    assert meta == {"kind": "t"}
+    assert [p["idx"] for p in out] == [0, 1, 2, 3]
+    # ONE shared table: the prefix term re-interns to the SAME object
+    # in every part (same contract as checkpoint.load_with_terms)
+    first = out[0]["prefix"]
+    assert all(p["prefix"] is first for p in out[1:])
+    assert first.tid == shared.tid  # hash-consed back onto the live DAG
+    assert first is shared
+
+
+def test_delta_matches_whole_on_randomized_fork_trees(codec_on):
+    rng = random.Random(7)
+    for trial in range(3):
+        # random fork tree: each part extends a random earlier one
+        parts = [{"path": (_term_chain("t%d" % trial, 4),),
+                  "guard": None, "d": 0}]
+        for i in range(1, 12):
+            parent = parts[rng.randrange(len(parts))]
+            step = T.mk_add(parent["path"][-1],
+                            T.bv_const(rng.randrange(1 << 16), 256))
+            guard = T.mk_ult(step, T.bv_var("cap_%d_%d" % (trial, i),
+                                            256))
+            parts.append({"path": parent["path"] + (step,),
+                          "guard": guard, "d": parent["d"] + 1})
+        blob = sc.encode_frame({"n": len(parts)}, parts)
+        _meta, out = sc.decode_frame(blob)
+        assert len(out) == len(parts)
+        for a, b in zip(parts, out):
+            assert a["d"] == b["d"]
+            assert tuple(t.tid for t in a["path"]) == \
+                tuple(t.tid for t in b["path"])
+            if a["guard"] is not None:
+                assert b["guard"] is a["guard"]
+
+
+def test_delta_primitives_verified_against_whole():
+    rng = random.Random(3)
+    ref = bytes(rng.randrange(256) for _ in range(4096))
+    for _ in range(20):
+        tgt = bytearray(ref)
+        for _ in range(rng.randrange(8)):
+            tgt[rng.randrange(len(tgt))] ^= 0xFF
+        tgt = bytes(tgt) + bytes(rng.randrange(256)
+                                 for _ in range(rng.randrange(64)))
+        rec = sc._delta_encode(ref, tgt)
+        if rec is not None:
+            assert sc._delta_apply(ref, rec) == tgt
+
+
+def test_frame_counters_account_bytes(codec_on):
+    c0 = _counters()
+    _shared, parts = _sibling_parts(8)
+    blob = sc.encode_frame({}, parts)
+    c1 = _counters()
+    raw = c1["codec_bytes_raw"] - c0["codec_bytes_raw"]
+    enc = c1["codec_bytes_encoded"] - c0["codec_bytes_encoded"]
+    assert enc == len(blob)
+    assert 0 < enc < raw  # siblings share structure -> real win
+    assert c1["codec_ref_hits"] > c0["codec_ref_hits"]
+
+
+# --------------------------------------------------- drop-whole classes
+
+
+def test_corrupt_frame_drops_whole(codec_on):
+    blob = sc.encode_frame({}, [{"t": _term_chain("c")}])
+    c0 = _counters()
+    with pytest.raises(sc.CodecError):
+        sc.decode_frame(blob[:-7])  # truncated pickle
+    with pytest.raises(sc.CodecError):
+        sc.decode_frame(b"JUNK" + blob[4:])  # bad magic
+    assert _counters()["codec_drop_whole"] == \
+        c0["codec_drop_whole"] + 2
+
+
+def test_version_skew_drops_whole(codec_on):
+    blob = sc.encode_frame({}, [{"t": _term_chain("v")}])
+    frame = pickle.loads(blob[len(sc.MAGIC):])
+    frame["v"] = sc.CODEC_VERSION + 1
+    skewed = sc.MAGIC + pickle.dumps(frame)
+    c0 = _counters()
+    with pytest.raises(sc.CodecError):
+        sc.decode_frame(skewed)
+    assert _counters()["codec_drop_whole"] == c0["codec_drop_whole"] + 1
+
+
+def test_missing_reference_drops_whole(codec_on, tmp_path):
+    base = sc.encode_frame({}, [{"t": _term_chain("b")}])
+    batch = tmp_path / "batch.bin"
+    batch.write_bytes(base)
+    rows_blob, _sha = sc.frame_table_blob(batch)
+    ref_frame = sc.encode_frame({}, [{"t": _term_chain("b")}],
+                                table_base=("batch.bin", rows_blob))
+    c0 = _counters()
+    # no loader at all
+    with pytest.raises(sc.CodecError):
+        sc.decode_frame(ref_frame)
+    # loader that cannot find the file
+    with pytest.raises(sc.CodecError):
+        sc.decode_frame(ref_frame,
+                        table_loader=sc.file_table_loader(
+                            tmp_path / "elsewhere"))
+    # hash skew: base rewritten since the sidecar referenced it
+    batch.write_bytes(sc.encode_frame({}, [{"t": _term_chain("x")}]))
+    with pytest.raises(sc.CodecError):
+        sc.decode_frame(ref_frame,
+                        table_loader=sc.file_table_loader(tmp_path))
+    assert _counters()["codec_drop_whole"] == c0["codec_drop_whole"] + 3
+
+
+# ------------------------------------------------------------ row plane
+
+
+def test_rows_roundtrip_identity(codec_on):
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, 1 << 30, size=(64, 33), dtype=np.int32)
+    rows = {
+        "pc": base[:, 0].copy(),
+        "plane": np.repeat(base[:1, :], 64, axis=0),  # sibling lanes
+        "flags": np.zeros((64, 4), dtype=np.int8),
+    }
+    blob = sc.encode_rows(rows)
+    assert blob is not None and blob[:len(sc.MAGIC_ROWS)] == \
+        sc.MAGIC_ROWS
+    out = sc.decode_rows(blob)
+    assert set(out) == set(rows)
+    for k in rows:
+        assert out[k].dtype == rows[k].dtype
+        assert out[k].shape == rows[k].shape
+        np.testing.assert_array_equal(out[k], rows[k])
+
+
+def test_rows_declines_when_no_win(codec_on):
+    rng = np.random.default_rng(5)
+    rows = {"noise": rng.integers(0, 1 << 64, size=(8, 97),
+                                  dtype=np.uint64)}
+    assert sc.encode_rows(rows) is None  # caller keeps the raw dict
+
+
+def test_ring_seam_identity_on_off(codec_on):
+    from mythril_tpu.laser.retire_ring import RetireRing
+
+    rows = {"plane": np.repeat(
+        np.arange(40, dtype=np.int32)[None, :], 32, axis=0)}
+    got = []
+    ring = RetireRing(workers=1, sink=got)
+    ring.submit(lambda: rows, lambda r: [r], payload=rows)
+    ring.flush()
+    sc.FORCE = False
+    got_off = []
+    ring_off = RetireRing(workers=1, sink=got_off)
+    ring_off.submit(lambda: rows, lambda r: [r], payload=rows)
+    ring_off.flush()
+    sc.FORCE = True
+    np.testing.assert_array_equal(got[0]["plane"], rows["plane"])
+    np.testing.assert_array_equal(got_off[0]["plane"], rows["plane"])
+
+
+# -------------------------------------------------------------- seams
+
+
+def _ckpt_roundtrip(tmp_path, name):
+    _shared, parts = _sibling_parts(5)
+    path = str(tmp_path / name)
+    assert ckpt.save_checkpoint(path, 3, parts[:3], 0xABC, "code1",
+                                inflight=parts[3:])
+    payload = ckpt.load_checkpoint(path, "code1")
+    assert payload is not None
+    return parts, payload, path
+
+
+def test_checkpoint_seam_identity_on_off(codec_on, tmp_path):
+    parts, on, on_path = _ckpt_roundtrip(tmp_path, "on.ckpt")
+    sc.FORCE = False
+    _parts2, off, off_path = _ckpt_roundtrip(tmp_path, "off.ckpt")
+    sc.FORCE = True
+    for payload in (on, off):
+        assert payload["round"] == 3
+        assert payload["target_address"] == 0xABC
+        assert [s["idx"] for s in payload["open_states"]] == [0, 1, 2]
+        assert [s["idx"] for s in payload["inflight"]] == [3, 4]
+    # v5 head + framed body on; legacy v4 head off
+    with open(on_path, "rb") as f:
+        assert pickle.load(f)["version"] == ckpt.VERSION_CODEC
+    with open(off_path, "rb") as f:
+        head = pickle.load(f)
+        assert head["version"] == ckpt.VERSION
+        assert "terms" in head
+    assert sc.MAGIC in open(on_path, "rb").read()
+    assert sc.MAGIC not in open(off_path, "rb").read()
+
+
+def test_checkpoint_corrupt_body_loads_fresh(codec_on, tmp_path):
+    _parts, _payload, path = _ckpt_roundtrip(tmp_path, "c.ckpt")
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:-9])
+    assert ckpt.load_checkpoint(path, "code1") is None
+
+
+def test_sidecar_seam_shares_batch_table(codec_on, tmp_path):
+    shared, parts = _sibling_parts(4)
+    batch = str(tmp_path / "mig.batch")
+    assert ckpt.save_checkpoint(batch, 1, parts, 0x1, "code1",
+                                include_modules=False)
+    side = batch + ".verdicts"
+    entries = [(p["own"], "UNSAT", i) for i, p in enumerate(parts)]
+    assert ckpt.save_verdict_sidecar(side, entries, table_from=batch)
+    # the sidecar's table is a REFERENCE to the batch's inline table
+    frame = pickle.loads(
+        open(side, "rb").read()[len(sc.MAGIC):])
+    assert frame["table"][0] == "ref"
+    assert frame["table"][1] == os.path.basename(batch)
+    out = ckpt.load_verdict_sidecar(side)
+    assert [(e[1], e[2]) for e in out] == \
+        [(v, i) for _t, v, i in entries]
+    assert [e[0].tid for e in out] == [e[0].tid for e in entries]
+    # batch gone -> reference unresolvable -> sidecar drops WHOLE
+    os.unlink(batch)
+    assert ckpt.load_verdict_sidecar(side) == []
+
+
+def test_sidecar_seam_identity_off(codec_off, tmp_path):
+    _shared, parts = _sibling_parts(3)
+    side = str(tmp_path / "legacy.verdicts")
+    entries = [(p["own"], "SAT", i) for i, p in enumerate(parts)]
+    assert ckpt.save_verdict_sidecar(side, entries)
+    data = open(side, "rb").read()
+    assert not sc.is_frame(data)  # legacy dump_with_terms format
+    out = ckpt.load_verdict_sidecar(side)
+    assert [(e[1], e[2]) for e in out] == \
+        [(v, i) for _t, v, i in entries]
+
+
+def test_warm_store_seam_identity_on_off(codec_on, tmp_path,
+                                         monkeypatch):
+    from mythril_tpu.support import warm_store
+    from mythril_tpu.support.checkpoint import STATIC_SIDECAR_SHAPE
+
+    key = "k" * 64
+    payload = {"version": warm_store.STORE_VERSION,
+               "static_shape": STATIC_SIDECAR_SHAPE,
+               "code_hash": key,
+               "verdicts": [(_term_chain("w", 3), "UNSAT")],
+               "cost": {"width_clamp": 0}}
+    for force, name in ((True, "on"), (False, "off")):
+        sc.FORCE = force
+        d = tmp_path / name
+        monkeypatch.setenv("MTPU_WARM_DIR", str(d))
+        assert warm_store._write_entry(key, dict(payload))
+        got = warm_store._read_entry(key)
+        assert got is not None
+        assert got["version"] == payload["version"]
+        assert got["code_hash"] == key
+        assert [v for _t, v in got["verdicts"]] == ["UNSAT"]
+        data = open(str(d / (key + ".warm")), "rb").read()
+        assert sc.is_frame(data) is force
+    sc.FORCE = True
+
+
+# ------------------------------------------------------ off-really-off
+
+
+def test_off_is_really_off(monkeypatch, tmp_path):
+    monkeypatch.setattr(sc, "FORCE", None)
+    monkeypatch.setenv("MTPU_CODEC", "0")
+    assert sc.enabled() is False
+    c0 = _counters()
+    _shared, parts = _sibling_parts(3)
+    p1, p2 = str(tmp_path / "a.ckpt"), str(tmp_path / "b.ckpt")
+    assert ckpt.save_checkpoint(p1, 2, parts, 0x9, "code1")
+    assert ckpt.save_checkpoint(p2, 2, parts, 0x9, "code1")
+    b1, b2 = open(p1, "rb").read(), open(p2, "rb").read()
+    assert b1 == b2  # deterministic legacy bytes
+    assert sc.MAGIC not in b1 and sc.MAGIC_ROWS not in b1
+    side = str(tmp_path / "a.verdicts")
+    assert ckpt.save_verdict_sidecar(side,
+                                     [(parts[0]["own"], "SAT", 0)])
+    assert not sc.is_frame(open(side, "rb").read())
+    assert ckpt.load_checkpoint(p1, "code1") is not None
+    assert _counters() == c0  # not one codec counter moved
+
+
+def test_gate_default_is_on(monkeypatch):
+    monkeypatch.setattr(sc, "FORCE", None)
+    monkeypatch.delenv("MTPU_CODEC", raising=False)
+    assert sc.enabled() is True
+    monkeypatch.setenv("MTPU_CODEC", "0")
+    assert sc.enabled() is False

@@ -345,9 +345,10 @@ def clean_autoprobe(monkeypatch):
     from mythril_tpu.laser import lane_engine
     from mythril_tpu.parallel import cost_model
 
-    monkeypatch.setattr(lane_engine, "CAPACITY_CLAMP", None)
-    monkeypatch.setattr(lane_engine, "_FAULT_PROBED", False)
+    monkeypatch.setattr(lane_engine, "CAPACITY_CLAMPS", {})
+    monkeypatch.setattr(lane_engine, "_FAULT_PROBED_SHAPES", set())
     monkeypatch.setattr(lane_engine, "_CLAMP_WARNED", False)
+    monkeypatch.setattr(cost_model, "WIDTH_CLAMPS", {})
     monkeypatch.setattr(cost_model, "WIDTH_CLAMP", None)
     yield monkeypatch
 
@@ -369,12 +370,12 @@ def test_autoprobe_clamps_and_persists(clean_autoprobe, tmp_path,
 
     clamp = lane_engine.note_kernel_fault(4096, probe=fake_probe)
     assert clamp == 512
-    assert lane_engine.CAPACITY_CLAMP == 512
-    assert cost_model.WIDTH_CLAMP == 512
+    assert lane_engine.CAPACITY_CLAMPS == {4096: 512}
+    assert cost_model.width_clamp_for(4096) == 512
     # the faulted width re-probes first (transient-failure screen)
     assert probed[0] == 4096
-    # once per process: a second fault changes nothing
-    assert lane_engine.note_kernel_fault(8192, probe=fake_probe) == 512
+    # once per SHAPE: a second fault at the same shape changes nothing
+    assert lane_engine.note_kernel_fault(4096, probe=fake_probe) == 512
 
     with caplog.at_level(logging.WARNING,
                          logger="mythril_tpu.laser.lane_engine"):
@@ -385,13 +386,61 @@ def test_autoprobe_clamps_and_persists(clean_autoprobe, tmp_path,
              if "capped" in r.getMessage()]
     assert len(warns) == 1, "clamp must WARN exactly once"
 
-    # persistence round trip (stats.json via cost_model)
+    # persistence round trip (stats.json via cost_model): the clamp
+    # persists as a per-shape map
     cost_model.save_stats(tmp_path, [{"contract": "a.sol.o",
                                       "wall_s": 1.0}])
     data = json.loads((tmp_path / "stats.json").read_text())
-    assert data["lane_width_clamp"] == 512
+    assert data["lane_width_clamp"] == {"4096": 512}
+    cost_model.WIDTH_CLAMPS = {}
     cost_model.WIDTH_CLAMP = None
-    assert cost_model.load_width_clamp(tmp_path) == 512
+    assert cost_model.load_width_clamp(tmp_path) == {4096: 512}
+    assert cost_model.width_clamp_for(4096) == 512
+
+
+def test_autoprobe_clamp_is_per_shape(clean_autoprobe, tmp_path):
+    """The PR-17 satellite headline: a fault at a big shape must not
+    clamp smaller shapes — each pow2 request shape keeps its own
+    clamp, and only its own."""
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.parallel import cost_model
+
+    # a 262144-lane probe session stable only up to 16384
+    assert lane_engine.note_kernel_fault(
+        262144, probe=lambda w, lk=None: w <= 16384) == 16384
+    # the 32k path never faulted: full width
+    assert lane_engine.pick_width(32768, 100000) == 32768
+    assert cost_model.width_clamp_for(32768) is None
+    # the faulted shape itself is clamped
+    assert lane_engine.pick_width(262144, 10**6) == 16384
+    # a second, tighter fault at ANOTHER shape coexists
+    assert lane_engine.note_kernel_fault(
+        8192, probe=lambda w, lk=None: w <= 2048) == 2048
+    assert lane_engine.pick_width(8192, 100000) == 2048
+    assert lane_engine.pick_width(262144, 10**6) == 16384
+
+
+def test_legacy_scalar_clamp_still_loads(clean_autoprobe, tmp_path):
+    """A pre-map stats.json carries ``lane_width_clamp`` as a bare
+    scalar: it loads as the shape-blind entry and binds every width
+    (the pre-PR-17 behavior), and the next save upgrades it to the
+    map form under key 0."""
+    import json as _json
+
+    from mythril_tpu.laser import lane_engine
+    from mythril_tpu.parallel import cost_model
+
+    (tmp_path / "stats.json").write_text(
+        _json.dumps({"version": 1, "contracts": {},
+                     "lane_width_clamp": 512}))
+    assert cost_model.load_width_clamp(tmp_path) == {0: 512}
+    assert cost_model.width_clamp_for(32768) == 512
+    assert cost_model.WIDTH_CLAMP == 512  # legacy mirror for old readers
+    assert lane_engine.pick_width(4096, 1000) == 512
+    cost_model.save_stats(tmp_path, [{"contract": "a.sol.o",
+                                      "wall_s": 1.0}])
+    data = _json.loads((tmp_path / "stats.json").read_text())
+    assert data["lane_width_clamp"] == {"0": 512}
 
 
 def test_autoprobe_transient_failure_does_not_clamp(clean_autoprobe):
@@ -401,7 +450,7 @@ def test_autoprobe_transient_failure_does_not_clamp(clean_autoprobe):
 
     assert lane_engine.note_kernel_fault(
         4096, probe=lambda w, lk=None: True) is None
-    assert lane_engine.CAPACITY_CLAMP is None
+    assert lane_engine.CAPACITY_CLAMPS == {}
     assert lane_engine.pick_width(4096, 1000) == 4096
 
 

@@ -70,18 +70,30 @@ def _save_entry(contract=None, bank=_bank_two_proofs):
 
 def _rewrite_payload(store_dir, key, mutate):
     """Load a saved entry's payload, apply ``mutate``, write it back
-    (through the checkpoint helpers — the same framing the store
-    uses)."""
+    (through the same framing the store used for the entry: a codec
+    frame when MTPU_CODEC was on at save, the legacy checkpoint
+    pickle otherwise)."""
+    import io
+
+    from mythril_tpu.support import state_codec
     from mythril_tpu.support.checkpoint import (
         dump_with_terms, load_with_terms,
     )
 
     path = Path(store_dir) / (key + ".warm")
-    with open(path, "rb") as f:
-        payload = load_with_terms(f)
-    mutate(payload)
-    with open(path, "wb") as f:
-        dump_with_terms(f, payload)
+    data = path.read_bytes()
+    if state_codec.is_frame(data):
+        meta, verdicts = state_codec.decode_frame(data)
+        payload = dict(meta)
+        payload["verdicts"] = list(verdicts)
+        mutate(payload)
+        verdicts = list(payload.pop("verdicts", ()))
+        path.write_bytes(state_codec.encode_frame(payload, verdicts))
+    else:
+        payload = load_with_terms(io.BytesIO(data))
+        mutate(payload)
+        with open(path, "wb") as f:
+            dump_with_terms(f, payload)
 
 
 def test_roundtrip_adopts_banks_and_counts(store):

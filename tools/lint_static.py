@@ -46,15 +46,17 @@ explicit.
 Rule 5 — raw-pickle-outside-checkpoint (the PR-10 lane-plane-sidecar
 class): calling ``pickle.dump`` / ``pickle.load`` / ``pickle.dumps`` /
 ``pickle.loads`` anywhere in ``mythril_tpu/`` outside
-``mythril_tpu/support/checkpoint.py``. Term-bearing object graphs
+``mythril_tpu/support/checkpoint.py`` and
+``mythril_tpu/support/state_codec.py``. Term-bearing object graphs
 (states, constraints, issues) MUST travel through the checkpoint
 helpers (``dump_with_terms`` / the sidecar savers): raw pickle
 recurses arbitrarily deep term DAGs (RecursionError on loop-heavy
 analyses), breaks hash-consing on load (duplicate terms with fresh
 tids defeat every fingerprint-keyed cache), and silently skips the
 version/code-identity framing the sidecar format carries. The
-checkpoint module is the one sanctioned seam; new sites must route
-through it — or be explicitly allowlisted with a reason.
+checkpoint module and the state codec built on its machinery are the
+sanctioned seams; new sites must route through them — or be
+explicitly allowlisted with a reason.
 
 Rule 6 — unbounded-retire-gather (the PR-11 64k-lane-wall class): a
 direct call to the escalation retire gather ``_retire_rows`` in
@@ -122,6 +124,24 @@ without the submit-order and within-tenant-merge guarantees the ring
 enforces. Constructors/assignments are fine (the tag has to be
 stamped somewhere); non-lane ``owner`` fields (the pack coordinator's
 member records) allowlist with a reason.
+
+Rule 11 — state-serialize-outside-codec (the ISSUE-17 shared-table
+class): calling a plane/term-table serialization primitive — the
+term-DAG flatteners ``_dag_rows`` / ``_intern_rows``, the
+term-collecting pickler classes ``_Pickler`` / ``_Unpickler``, or the
+byte-delta primitives ``_delta_encode`` / ``_delta_apply`` /
+``_pickle_with_table`` — anywhere in ``mythril_tpu/`` outside
+``mythril_tpu/support/state_codec.py`` and
+``mythril_tpu/support/checkpoint.py``. The same one-sanctioned-seam
+shape as rules 5/8/9/10: these primitives only compose soundly inside
+the codec's frame contract (one shared table per boundary, tid
+re-intern identity, encode-time delta verification, drop-whole on
+skew). An ad-hoc caller would emit planes no decoder validates — or
+re-intern rows outside ``_LOAD_TERMS`` scoping and mint duplicate
+tids. Everything else goes through the public surface
+(``encode_frame`` / ``decode_frame`` / ``encode_rows`` /
+``decode_rows`` / ``dump_with_terms`` / the sidecar savers) — or
+allowlists with a reason.
 
 Allowlist: tools/lint_allowlist.txt, one ``<relpath>:<line-tag>`` per
 line (``<relpath>:*`` allows a whole file); ``#`` comments.
@@ -194,10 +214,12 @@ _RULE3_ROOTS = ("mythril_tpu/ops/", "mythril_tpu/smt/solver/")
 _RULE4_ROOTS = ("mythril_tpu/parallel/",
                 "mythril_tpu/support/telemetry/")
 
-#: rule-5: the one file allowed to touch raw pickle (it IS the
-#: sanctioned term-safe serialization seam), and the calls banned
+#: rule-5: the files allowed to touch raw pickle (checkpoint IS the
+#: sanctioned term-safe serialization seam; the state codec builds
+#: its frame format on the same machinery), and the calls banned
 #: everywhere else in the package
-_RULE5_EXEMPT = "mythril_tpu/support/checkpoint.py"
+_RULE5_EXEMPT = ("mythril_tpu/support/checkpoint.py",
+                 "mythril_tpu/support/state_codec.py")
 _PICKLE_CALLS = frozenset(("dump", "load", "dumps", "loads"))
 
 #: rule-6 scope + sanctioned enclosing functions: _retire_chunked IS
@@ -327,6 +349,40 @@ def _rule10_findings(rel: str, tree) -> List["Finding"]:
                 "TenantRouter) — ad-hoc owner peeks bypass the "
                 "ring's per-tenant delivery guarantees; route "
                 "through owner_of or allowlist with a reason"))
+    return out
+
+
+#: rule-11: the two modules allowed to call the plane/term-table
+#: serialization primitives (the codec frame contract and the
+#: checkpoint machinery it builds on), and the primitive names banned
+#: everywhere else in the package
+_RULE11_SANCTIONED = ("mythril_tpu/support/state_codec.py",
+                      "mythril_tpu/support/checkpoint.py")
+_RULE11_SERIALIZE_FNS = frozenset(
+    ("_dag_rows", "_intern_rows", "_Pickler", "_Unpickler",
+     "_delta_encode", "_delta_apply", "_pickle_with_table"))
+
+
+def _rule11_findings(rel: str, tree) -> List["Finding"]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name in _RULE11_SERIALIZE_FNS:
+            out.append(Finding(
+                rel, node.lineno, "state-serialize-outside-codec",
+                "plane/term-table serialization primitive ({}) "
+                "outside support/state_codec.py + "
+                "support/checkpoint.py — the shared-table frame "
+                "contract (tid re-intern identity, encode-time delta "
+                "verification, drop-whole on skew) lives there; use "
+                "the public codec/checkpoint surface (encode_frame/"
+                "decode_frame/encode_rows/decode_rows/"
+                "dump_with_terms) or allowlist with a "
+                "reason".format(name)))
     return out
 
 
@@ -559,7 +615,11 @@ def lint_file(path: Path) -> List[Finding]:
     if rel.startswith(_RULE10_ROOT) and rel != _RULE10_EXEMPT:
         out.extend(_rule10_findings(rel, tree))
 
-    if rel.startswith("mythril_tpu/") and rel != _RULE5_EXEMPT:
+    if rel.startswith("mythril_tpu/") and \
+            rel not in _RULE11_SANCTIONED:
+        out.extend(_rule11_findings(rel, tree))
+
+    if rel.startswith("mythril_tpu/") and rel not in _RULE5_EXEMPT:
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) and _is_raw_pickle_call(node):
                 out.append(Finding(

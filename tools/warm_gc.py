@@ -10,7 +10,14 @@ policy the corpus runner applies automatically after each merge
 or CI store directories.
 
     python tools/warm_gc.py DIR [--max-entries N] [--max-age-days D]
-                                [--dry-run]
+                                [--dry-run] [--flightrec]
+
+``--flightrec`` treats DIR as a crash flight recorder's dump
+directory (``<out-dir>/flightrec/``) instead of a warm store: aged
+dump artifacts and over-cap ``resume_rank*.ckpt`` live checkpoints GC
+under the same count/age/LRU caps, and ``*.ckpt.verdicts`` sidecars
+orphaned by a missing checkpoint go with them (a sidecar can never be
+replayed without the snapshot it rode with).
 
 ``--dry-run`` prints what WOULD be removed without unlinking. Exit 0
 always (a GC failure must never fail a pipeline); the summary prints
@@ -38,11 +45,18 @@ def main(argv=None) -> int:
                         "unlimited)")
     parser.add_argument("--dry-run", action="store_true",
                         help="report removals without unlinking")
+    parser.add_argument("--flightrec", action="store_true",
+                        help="GC a flight-recorder dump directory "
+                        "(aged dumps, over-cap resume checkpoints, "
+                        "orphaned .ckpt.verdicts sidecars) instead "
+                        "of a warm store")
     args = parser.parse_args(argv)
 
     from mythril_tpu.support import warm_store
 
-    summary = warm_store.gc_store(
+    gc = warm_store.gc_flightrec if args.flightrec \
+        else warm_store.gc_store
+    summary = gc(
         path=args.dir, max_entries=args.max_entries,
         max_age_days=args.max_age_days, dry_run=args.dry_run)
     print(json.dumps(summary))
